@@ -1,0 +1,70 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capability surface of Apache MXNet (reference: BullDemonKing/incubator-mxnet).
+
+Idiomatic usage mirrors MXNet::
+
+    import incubator_mxnet_tpu as mx
+
+    a = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = mx.nd.dot(a, a.T)
+    ...
+
+Architecture (see SURVEY.md): the reference's ThreadedEngine / mshadow /
+NCCL native stack is replaced by XLA/PJRT — async dispatch comes from PJRT
+streams, kernels from XLA (+ Pallas for hand-tuned hot ops), collectives from
+XLA over ICI/DCN via jax.sharding — while the user-facing capability surface
+(NDArray mutation semantics, autograd tape, Gluon, Trainer/kvstore, data
+pipeline, AMP, profiler, checkpoints) is rebuilt natively on that substrate.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Reference float32 ops run full-precision (cuBLAS fp32); match that for
+# float32 arrays. Performance-critical paths use bf16 arrays (AMP), which hit
+# the MXU natively regardless of this setting.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+from . import base
+from . import config as _config_mod
+from .config import config
+from .device import (Context, Device, cpu, cpu_pinned, cpu_shared,
+                     current_context, gpu, gpu_memory_info, num_gpus,
+                     num_tpus, tpu)
+from . import ndarray
+from . import ndarray as nd  # mx.nd alias, reference-style
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import runtime
+
+import sys as _sys
+from types import ModuleType as _ModuleType
+
+# legacy `mx.context` module alias (reference python/mxnet/context.py)
+context = _ModuleType(__name__ + ".context")
+context.Context = Context
+context.cpu = cpu
+context.gpu = gpu
+context.tpu = tpu
+context.num_gpus = num_gpus
+context.current_context = current_context
+_sys.modules[context.__name__] = context
+
+
+def __getattr__(name):
+    # Lazy subpackages to keep import light and avoid cycles.
+    if name in ("gluon", "optimizer", "initializer", "lr_scheduler",
+                "kvstore", "metric", "io", "image", "recordio", "amp",
+                "profiler", "parallel", "symbol", "sym", "module", "model_zoo",
+                "test_utils", "onnx"):
+        import importlib
+
+        mod = importlib.import_module(
+            "." + {"sym": "symbol", "model_zoo": "gluon.model_zoo"}.get(
+                name, name), __name__)
+        setattr(_sys.modules[__name__], name, mod)
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

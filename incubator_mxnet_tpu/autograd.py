@@ -1,0 +1,439 @@
+"""Imperative autograd tape.
+
+Capability parity with the reference's autograd (``python/mxnet/autograd.py``
+frontend over ``Imperative::Backward`` / ``AGInfo`` in
+``src/imperative/imperative.cc``, SURVEY.md §2.1 "Autograd tape"):
+``record()/pause()`` scopes, ``is_recording()/is_training()``,
+``mark_variables``, ``backward()`` with head gradients, ``grad()`` with
+``create_graph`` for higher-order derivatives, and a custom ``Function``.
+
+TPU-native redesign: the reference re-executes a derived nnvm graph through
+its engine; here every recorded op captures a ``jax.vjp`` closure at dispatch
+time (residuals live on device, dispatch stays async via PJRT), and
+``backward()`` walks the tape in reverse topological order calling those
+closures. Higher-order grad works because a vjp closure is itself a jax-
+traceable function, so with ``create_graph=True`` the backward pass is simply
+recorded onto the tape again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _TLS()
+
+
+# ---------------------------------------------------------------------------
+# Recording scopes
+# ---------------------------------------------------------------------------
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = _state.recording
+            _state.recording = self._enter_record
+        if self._enter_train is not None:
+            self._prev_train = _state.training
+            _state.training = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_record is not None:
+            _state.recording = self._prev_record
+        if self._enter_train is not None:
+            _state.training = self._prev_train
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — turn on recording (+training mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """``with autograd.pause():`` — turn off recording inside ``record``."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _state.recording = _state.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev, _state.training = _state.training, train
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape structure
+# ---------------------------------------------------------------------------
+class Node:
+    """One recorded op application (the AGInfo analog).
+
+    ``vjp_fn`` maps output cotangents -> input cotangents. ``parents`` are the
+    producing (node, out_idx) edges of each op input captured at record time
+    (NDArray handles may be rebound later; edges are by-value). ``receivers``
+    are the NDArray objects whose ``.grad`` should accumulate input cotangents
+    (marked variables). ``pure_fn``/``in_data`` retain the primal so that
+    ``create_graph=True`` can re-derive the vjp *as a recorded op* (residual
+    closures hide input dependencies from the tape; re-deriving via
+    ``jax.vjp`` inside a recorded function restores them — rematerialization,
+    the same trade the reference's mirroring makes).
+    """
+
+    __slots__ = ("vjp_fn", "parents", "receivers", "n_outputs", "out_avals",
+                 "name", "pure_fn", "in_data", "in_objs")
+
+    def __init__(self, vjp_fn, parents, receivers, n_outputs, out_avals,
+                 name="", pure_fn=None, in_data=None, in_objs=None):
+        self.vjp_fn = vjp_fn
+        self.parents = parents        # List[Optional[Tuple[Node, int]]]
+        self.receivers = receivers    # List[Optional[NDArray]] (marked vars)
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # List[jax.ShapeDtypeStruct]
+        self.name = name
+        self.pure_fn = pure_fn        # primal jax fn (for create_graph)
+        self.in_data = in_data        # input jax arrays at record time
+        self.in_objs = in_objs        # original NDArray handles at record time
+
+
+def _zeros_like_aval(aval):
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def record_op(vjp_fn, inputs: Sequence[Any], outputs: Sequence[Any],
+              name: str = "", pure_fn=None, in_data=None):
+    """Attach a tape node to ``outputs`` (NDArrays) for op ``name``.
+
+    ``inputs`` are the NDArray operands at dispatch time.
+    """
+    parents: List[Optional[Tuple[Node, int]]] = []
+    receivers: List[Optional[Any]] = []
+    for x in inputs:
+        node = getattr(x, "_ag_node", None)
+        idx = getattr(x, "_ag_out_idx", 0)
+        parents.append((node, idx) if node is not None else None)
+        receivers.append(x if getattr(x, "_grad", None) is not None else None)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outputs]
+    node = Node(vjp_fn, parents, receivers, len(outputs), out_avals, name,
+                pure_fn=pure_fn,
+                in_data=[x._data for x in inputs] if pure_fn is not None else None,
+                in_objs=list(inputs) if pure_fn is not None else None)
+    for i, o in enumerate(outputs):
+        o._ag_node = node
+        o._ag_out_idx = i
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference ``autograd.mark_variables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad if req != "null" else None
+        var._grad_req = req
+        # A marked variable is a leaf: cut any producer edge.
+        var._ag_node = None
+        var._ag_out_idx = 0
+
+
+# ---------------------------------------------------------------------------
+# Backward execution
+# ---------------------------------------------------------------------------
+def _toposort(roots: Sequence[Node]) -> List[Node]:
+    """Reverse-topological order (outputs first)."""
+    visited = set()
+    order: List[Node] = []
+    stack: List[Tuple[Node, bool]] = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for edge in node.parents:
+            if edge is not None and id(edge[0]) not in visited:
+                stack.append((edge[0], False))
+    order.reverse()  # roots first
+    return order
+
+
+def _run_backward(heads, head_grads, variables=None, retain_graph=False,
+                  create_graph=False):
+    """Core backward walk. If ``variables`` given, return their grads instead
+    of writing ``.grad`` (reference ``autograd.grad``)."""
+    from .ndarray import NDArray  # circular-safe
+
+    heads = list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    roots = []
+    # cotangent accumulator keyed by (id(node), out_idx)
+    cotangents: Dict[Tuple[int, int], Any] = {}
+    node_by_id: Dict[int, Node] = {}
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            raise ValueError(
+                "cannot differentiate a head that was not computed under "
+                "autograd.record()")
+        ct = hg._data if isinstance(hg, NDArray) else hg
+        if ct is None:
+            ct = jnp.ones(h.shape, h.dtype)
+        key = (id(node), h._ag_out_idx)
+        cotangents[key] = cotangents.get(key)
+        cotangents[key] = ct if cotangents[key] is None else cotangents[key] + ct
+        node_by_id[id(node)] = node
+        roots.append(node)
+
+    order = _toposort(roots)
+
+    var_grads: Optional[Dict[int, Any]] = None
+    var_set = None
+    if variables is not None:
+        var_grads = {}
+        var_set = {id(v): i for i, v in enumerate(variables)}
+    written: set = set()  # grad buffers first-touched this backward call
+
+    def _accumulate(key, val):
+        cur = cotangents.get(key)
+        cotangents[key] = val if cur is None else cur + val
+
+    for node in order:
+        cts = []
+        any_ct = False
+        for i in range(node.n_outputs):
+            ct = cotangents.pop((id(node), i), None)
+            if ct is None:
+                ct = _zeros_like_aval(node.out_avals[i])
+            else:
+                any_ct = True
+            cts.append(ct)
+        if not any_ct:
+            continue
+        ct_in = _apply_vjp(node, cts, create_graph)
+        for x_idx, (edge, recv) in enumerate(zip(node.parents, node.receivers)):
+            g = ct_in[x_idx]
+            if g is None:
+                continue
+            if recv is not None:
+                if var_set is not None and id(recv) in var_set:
+                    slot = var_set[id(recv)]
+                    prev = var_grads.get(slot)
+                    var_grads[slot] = g if prev is None else prev + g
+                elif var_set is None:
+                    _write_grad(recv, g, written)
+            if edge is not None:
+                _accumulate((id(edge[0]), edge[1]), g)
+
+    if variables is not None:
+        out = []
+        for i, v in enumerate(variables):
+            g = var_grads.get(i)
+            if g is None:
+                g = jnp.zeros(v.shape, v.dtype)
+            # keep NDArray results as-is: with create_graph=True they carry
+            # tape nodes that a second grad() call differentiates through
+            out.append(g if isinstance(g, NDArray) else NDArray(g, ctx=v.ctx))
+        return out
+    return None
+
+
+def _apply_vjp(node: Node, cts: List[Any], create_graph: bool) -> Tuple:
+    """Run a node's vjp closure; optionally record it for higher-order grad."""
+    vjp_fn = node.vjp_fn
+    arg = tuple(cts) if node.n_outputs > 1 else cts[0]
+    if not create_graph:
+        with _RecordingStateScope(False, None):
+            return vjp_fn(arg)
+    # Higher-order: the vjp call itself must land on the tape, with the
+    # *primal inputs* as tape inputs (residual closures hide them). We
+    # re-derive the vjp inside a recorded function via jax.vjp — the grad of
+    # grad then traces through it.
+    from .ndarray import NDArray
+
+    if is_recording() and node.pure_fn is not None:
+        ct_nds = [ct if isinstance(ct, NDArray) else NDArray(ct) for ct in cts]
+        in_nds = []
+        for obj, data in zip(node.in_objs, node.in_data):
+            snap = NDArray(data)
+            snap._ag_node = getattr(obj, "_ag_node", None)
+            snap._ag_out_idx = getattr(obj, "_ag_out_idx", 0)
+            # rebuild edges from the *record-time* parents (obj may have been
+            # rebound since); node.parents is authoritative
+            in_nds.append(snap)
+        for i, edge in enumerate(node.parents):
+            if edge is not None:
+                in_nds[i]._ag_node, in_nds[i]._ag_out_idx = edge
+            else:
+                in_nds[i]._ag_node = None
+        for i, (obj, snap) in enumerate(zip(node.in_objs, in_nds)):
+            if getattr(obj, "_grad", None) is not None:
+                snap._grad = obj._grad          # shared buffer: writes land
+                snap._grad_req = obj._grad_req  # on the real variable
+
+        n_out, n_in = node.n_outputs, len(in_nds)
+        pure = node.pure_fn
+
+        def bw(*arrays):
+            cts_ = arrays[:n_out]
+            prims = arrays[n_out:]
+            _, inner = jax.vjp(pure, *prims)
+            return inner(tuple(cts_) if n_out > 1 else cts_[0])
+
+        all_in = ct_nds + in_nds
+        out_data, outer_vjp = jax.vjp(bw, *[a._data for a in all_in])
+        out_nds = [NDArray(o) for o in out_data]
+        record_op(outer_vjp, all_in, out_nds,
+                  name=f"backward({node.name})", pure_fn=bw)
+        return tuple(out_nds)
+    with _RecordingStateScope(False, None):
+        return vjp_fn(arg)
+
+
+def _write_grad(var, g, written: set) -> None:
+    """Accumulate a cotangent into a marked variable's grad buffer.
+
+    'write' semantics: first touch *per backward call* replaces, later
+    touches (multiple paths / snapshots sharing the buffer) accumulate.
+    Freshness is keyed on the grad buffer, not the handle — higher-order
+    snapshots share buffers across distinct handles.
+    """
+    from .ndarray import NDArray
+
+    if isinstance(g, NDArray):
+        g = g._data
+    req = getattr(var, "_grad_req", "write")
+    if req == "null" or var._grad is None:
+        return
+    buf_id = id(var._grad)
+    if req == "add" or buf_id in written:
+        var._grad._data = var._grad._data + g
+    else:
+        var._grad._data = jnp.asarray(g, var._grad.dtype)
+        written.add(buf_id)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """``autograd.backward([y])`` — write grads into marked variables."""
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    _run_backward(heads, head_grads, None, retain_graph, False)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Differentiate ``heads`` w.r.t. ``variables``; return grads as NDArrays.
+
+    Supports ``create_graph=True`` for higher-order gradients (reference
+    ``autograd.grad``).
+    """
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    single = not isinstance(variables, (list, tuple))
+    variables = [variables] if single else list(variables)
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    # variables must be leaves on the tape; ensure they were marked or are
+    # inputs of recorded ops. For grad() we track by object identity.
+    for v in variables:
+        if getattr(v, "_grad", None) is None:
+            # temporarily mark so record-time receivers catch them next time;
+            # for already-recorded graphs identity check in _run_backward
+            # relies on receivers, so require attach_grad beforehand.
+            raise ValueError(
+                "autograd.grad: variables must have grad attached "
+                "(call x.attach_grad() before recording)")
+    if create_graph:
+        with _RecordingStateScope(True, None):
+            out = _run_backward(heads, head_grads, variables, True, True)
+    else:
+        out = _run_backward(heads, head_grads, variables,
+                            bool(retain_graph), False)
+    return out[0] if single else out
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable Function (reference autograd.Function)
+# ---------------------------------------------------------------------------
+class Function:
+    """User-defined differentiable op.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` working on NDArrays (reference
+    ``mx.autograd.Function``).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(cts):
+                cts = (cts,) if not isinstance(cts, tuple) else cts
+                with _RecordingStateScope(False, None):
+                    ct_nds = [NDArray(c) for c in cts]
+                    in_grads = func.backward(*ct_nds)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(
+                    g._data if isinstance(g, NDArray) else g for g in in_grads)
+
+            record_op(vjp_fn, list(inputs), outs, name=type(self).__name__)
+        return outs[0] if single else tuple(outs)

@@ -1,0 +1,50 @@
+"""Shared helpers (the dmlc-core analog: checks, dtype plumbing).
+
+Reference: ``python/mxnet/base.py`` holds the ctypes FFI into libmxnet.so.
+Here there is no C boundary for the compute path — jax IS the backend — so
+this module only keeps the small shared utilities.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": None,  # resolved lazily via ml_dtypes/jnp
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def resolve_dtype(dtype):
+    """Normalize a dtype spec (str/np dtype/jnp dtype) to a numpy-compatible dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_ALIASES:
+            return _np.dtype(_DTYPE_ALIASES[dtype])
+        return _np.dtype(dtype)
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    return _np.dtype(dtype).name if not hasattr(dtype, "name") else str(dtype.name)
+
+
+class MXTPUError(RuntimeError):
+    """Base error class (reference: MXNetError via MXGetLastError)."""
+
+
+def check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise MXTPUError(msg)

@@ -1,0 +1,138 @@
+"""Environment-knob configuration system.
+
+Capability parity with the reference's three-tier config system (SURVEY.md §5
+"Config/flag system"): MXNet exposes ~100 ``MXNET_*`` env vars read by
+``dmlc::GetEnv`` (upstream ``docs/.../env_var.md``), declarative
+``dmlc::Parameter`` structs per op, and build-time feature flags surfaced via
+libinfo (``src/libinfo.cc``).
+
+TPU-native redesign: one declarative registry of typed env knobs (``MXTPU_*``,
+with the ``MXNET_*`` spelling accepted as an alias for drop-in scripts), read
+lazily and cached, with docs attached so ``describe()`` can print the full knob
+table the way the reference's env_var.md documents its knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_BOOL_TRUE = frozenset(("1", "true", "yes", "on"))
+_BOOL_FALSE = frozenset(("0", "false", "no", "off", ""))
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in _BOOL_TRUE:
+        return True
+    if v in _BOOL_FALSE:
+        return False
+    raise ValueError(f"cannot parse boolean env value {s!r}")
+
+
+@dataclasses.dataclass
+class Knob:
+    name: str
+    default: Any
+    type: Callable[[str], Any]
+    doc: str = ""
+
+
+class _Config:
+    """Process-global typed env-var registry with caching."""
+
+    def __init__(self) -> None:
+        self._knobs: Dict[str, Knob] = {}
+        self._cache: Dict[str, Any] = {}
+        self._overrides: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, default: Any, type: Callable[[str], Any], doc: str = "") -> None:
+        with self._lock:
+            self._knobs[name] = Knob(name, default, type, doc)
+
+    def _env_lookup(self, name: str) -> Optional[str]:
+        # Accept both MXTPU_* (native spelling) and MXNET_* (reference alias).
+        for candidate in (name, name.replace("MXTPU_", "MXNET_", 1)):
+            if candidate in os.environ:
+                return os.environ[candidate]
+        return None
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+            if name in self._cache:
+                return self._cache[name]
+            knob = self._knobs.get(name)
+            raw = self._env_lookup(name)
+            if raw is None:
+                val = knob.default if knob is not None else None
+            else:
+                parser = knob.type if knob is not None else str
+                val = parser(raw)
+            self._cache[name] = val
+            return val
+
+    def set(self, name: str, value: Any) -> None:
+        """Runtime override (takes precedence over env)."""
+        with self._lock:
+            self._overrides[name] = value
+
+    def unset(self, name: str) -> None:
+        with self._lock:
+            self._overrides.pop(name, None)
+            self._cache.pop(name, None)
+
+    def describe(self) -> str:
+        lines = ["Registered configuration knobs (env vars; MXNET_* accepted as alias):", ""]
+        for knob in sorted(self._knobs.values(), key=lambda k: k.name):
+            lines.append(f"  {knob.name} (default={knob.default!r}): {knob.doc}")
+        return "\n".join(lines)
+
+
+config = _Config()
+
+# ---------------------------------------------------------------------------
+# Core knobs (analogs of the reference's env_var.md table).
+# ---------------------------------------------------------------------------
+config.register(
+    "MXTPU_ENGINE_TYPE", "async", str,
+    "Execution mode: 'async' (PJRT async dispatch, default) or 'naive' "
+    "(synchronize after every op — the NaiveEngine debugging analog; see "
+    "reference src/engine/naive_engine.cc).")
+config.register(
+    "MXTPU_ENFORCE_DETERMINISM", False, _parse_bool,
+    "Force deterministic XLA reductions/compilation where supported.")
+config.register(
+    "MXTPU_DEFAULT_DTYPE", "float32", str,
+    "Default dtype for new NDArrays (reference default: float32).")
+config.register(
+    "MXTPU_SAFE_ACCUMULATION", True, _parse_bool,
+    "Accumulate bf16/fp16 reductions in float32 (reference MXNET_SAFE_ACCUMULATION).")
+config.register(
+    "MXTPU_TEST_SEED", None, int,
+    "Fixed seed for the test suite (reference MXNET_TEST_SEED).")
+config.register(
+    "MXTPU_EXEC_BULK_EXEC_TRAIN", True, _parse_bool,
+    "Enable whole-step jit bulking in CachedOp/hybridize (reference op bulking).")
+config.register(
+    "MXTPU_PROFILER_AUTOSTART", False, _parse_bool,
+    "Start the profiler at import time (reference MXNET_PROFILER_AUTOSTART).")
+config.register(
+    "MXTPU_OPTIMIZER_AGGREGATION_SIZE", 60, int,
+    "Max tensors fused into one aggregated optimizer update "
+    "(reference MXNET_OPTIMIZER_AGGREGATION_SIZE).")
+config.register(
+    "MXTPU_KVSTORE_BIGARRAY_BOUND", 1 << 19, int,
+    "Threshold above which kvstore shards a tensor for comm "
+    "(reference MXNET_KVSTORE_BIGARRAY_BOUND).")
+config.register(
+    "MXTPU_GPU_MEM_POOL_RESERVE", 5, int,
+    "Percent of device memory kept free by the allocator facade.")
+
+
+def is_naive_engine() -> bool:
+    return str(config.get("MXTPU_ENGINE_TYPE")).lower() == "naive"

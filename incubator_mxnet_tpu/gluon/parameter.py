@@ -1,0 +1,317 @@
+"""Gluon Parameter / ParameterDict.
+
+Capability parity with reference ``python/mxnet/gluon/parameter.py``
+(SURVEY.md §2.2 "Gluon core"): deferred initialization resolved by the first
+forward's shapes, ``grad_req`` modes, per-parameter initializer override,
+``data()/grad()/set_data/zero_grad/cast``, shared parameters, and save/load.
+
+TPU-native redesign: the reference keeps one copy of each parameter per
+device (``_data: list[NDArray]`` indexed by ctx) and reduces gradients across
+copies via kvstore. Here a Parameter owns ONE logical NDArray which may be
+*sharded or replicated over a jax Mesh* (global-array SPMD, SURVEY.md §7
+hard-part 3); ``data(ctx)`` returns that logical array. The kvstore facade
+performs psum over the mesh instead of cross-copy reduction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import initializer as init_mod
+from ..device import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndimpl
+
+
+class DeferredInitializationError(RuntimeError):
+    pass
+
+
+class _TraceCtx(threading.local):
+    """Active CachedOp trace (hybridize): parameters resolve to tracer-backed
+    NDArrays and forward-time parameter mutations are captured as functional
+    aux-updates instead of eager rebinds."""
+
+    def __init__(self):
+        self.stack = []
+
+
+_trace = _TraceCtx()
+
+
+def current_trace():
+    return _trace.stack[-1] if _trace.stack else None
+
+
+class Parameter:
+    def __init__(self, name: str = "param", grad_req: str = "write",
+                 shape=None, dtype=np.float32, init=None,
+                 allow_deferred_init: bool = True, differentiable: bool = True,
+                 lr_mult: float = 1.0, wd_mult: float = 1.0,
+                 stype: str = "default", grad_stype: str = "default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.init = init
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.allow_deferred_init = allow_deferred_init
+        self._data: Optional[NDArray] = None
+        self._deferred = None          # (init, ctx) waiting for a shape
+        self._sharding = None          # jax NamedSharding set by parallel layer
+        self._structure_name = None    # block-tree path, set by Block
+
+    # -- init ---------------------------------------------------------------
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    def _shape_known(self) -> bool:
+        return (self.shape is not None and len(self.shape) > 0
+                and all(s > 0 for s in self.shape))
+
+    def initialize(self, init=None, ctx: Optional[Context] = None,
+                   default_init=None, force_reinit: bool = False) -> None:
+        """Materialize the parameter (reference ``Parameter.initialize``).
+        With unknown shape, registers a deferred init completed on first
+        forward."""
+        if self._data is not None and not force_reinit:
+            return
+        chosen = init or self.init or default_init or "uniform"
+        ctx = ctx or current_context()
+        if not self._shape_known():
+            if not self.allow_deferred_init:
+                raise ValueError(
+                    f"parameter {self.name} has unknown shape {self.shape} "
+                    "and deferred init is disallowed")
+            self._deferred = (chosen, ctx)
+            return
+        self._materialize(chosen, ctx)
+
+    def _materialize(self, init_spec, ctx: Context) -> None:
+        initializer = init_mod.create(init_spec)
+        nd = initializer.init_array(self.name, self.shape, self.dtype)
+        if ctx is not None and ctx.kind != "cpu":
+            nd = nd.as_in_context(ctx)
+        self._data = nd
+        self._deferred = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, shape) -> None:
+        """Complete deferred init once the first forward reveals the shape."""
+        if self.shape is not None and self._shape_known():
+            pass
+        else:
+            known = tuple(int(s) for s in shape)
+            if self.shape is not None and len(self.shape) == len(known):
+                known = tuple(k if s == 0 or s is None or s < 0 else s
+                              for s, k in zip(self.shape, known))
+            self.shape = known
+        if self._deferred is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} was not initialized; call "
+                ".initialize() before the first forward")
+        init_spec, ctx = self._deferred
+        self._materialize(init_spec, ctx)
+
+    # -- access -------------------------------------------------------------
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        tr = current_trace()
+        if tr is not None:
+            got = tr.param_value(self)
+            if got is not None:
+                return got
+        if self._data is None:
+            if self._deferred is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred init not yet complete")
+            raise RuntimeError(
+                f"parameter {self.name} not initialized; call .initialize()")
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        d = self._data
+        if d is None or d._grad is None:
+            raise RuntimeError(
+                f"parameter {self.name} has no gradient (grad_req="
+                f"{self._grad_req!r})")
+        return d._grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self) -> List[Context]:
+        return [self._data.ctx] if self._data is not None else []
+
+    def zero_grad(self) -> None:
+        if self._data is not None and self._data._grad is not None:
+            import jax.numpy as jnp
+
+            self._data._grad._data = jnp.zeros_like(self._data._grad._data)
+
+    def set_data(self, data) -> None:
+        tr = current_trace()
+        if tr is not None:
+            tr.record_aux_update(self, data)
+            return
+        if self._data is None:
+            nd = data if isinstance(data, NDArray) else NDArray(data)
+            self.shape = nd.shape
+            self._data = NDArray(nd._data, dtype=self.dtype)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+            return
+        self._data._set_data(data)
+
+    def cast(self, dtype) -> None:
+        from ..base import resolve_dtype
+
+        self.dtype = resolve_dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = self._data.astype(self.dtype)
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    def reset_ctx(self, ctx) -> None:
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    def var(self):
+        raise NotImplementedError("symbol world arrives with the module shim")
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name if not hasattr(self.dtype, 'name') else self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference gluon.Constant)."""
+
+    def __init__(self, name, value):
+        value = value if isinstance(value, NDArray) else NDArray(value)
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, differentiable=False)
+        self._value_nd = value
+        self.init = "zeros"
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        self._data = self._value_nd
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with sharing semantics (reference
+    ``gluon.ParameterDict``)."""
+
+    def __init__(self, prefix: str = "", shared: Optional["ParameterDict"] = None):
+        self.prefix = prefix
+        self._params: Dict[str, Parameter] = {}
+        self._shared = shared
+
+    def get(self, name: str, **kwargs) -> Parameter:
+        full = self.prefix + name
+        if full in self._params:
+            return self._params[full]
+        if self._shared is not None and full in self._shared._params:
+            self._params[full] = self._shared._params[full]
+            return self._params[full]
+        p = Parameter(name=full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def update(self, other: "ParameterDict") -> None:
+        self._params.update(other._params)
+
+    def initialize(self, init=None, ctx=None, force_reinit=False,
+                   verbose=False) -> None:
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init or "uniform",
+                         force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self._params.values():
+            p.zero_grad()
+
+    def setattr(self, name, value) -> None:
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx) -> None:
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def save(self, fname: str, strip_prefix: str = "") -> None:
+        arg = {}
+        for name, p in self._params.items():
+            if p._data is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
+                else name
+            arg[key] = p.data()
+        _ndimpl.save(fname, arg)
+
+    def load(self, fname: str, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="") -> None:
+        loaded = _ndimpl.load(fname, ctx=ctx)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                if p._data is None:
+                    p.shape = loaded[name].shape
+                    p._deferred = p._deferred or ("zeros",
+                                                  ctx or current_context())
+                    p._materialize(p._deferred[0], p._deferred[1])
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f"parameter {name} missing from {fname}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise KeyError(f"file {fname} has extra parameters {extra}")
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, name: str) -> Parameter:
+        return self._params[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._params
+
+    def __repr__(self):
+        lines = [f"ParameterDict (prefix={self.prefix!r})"]
+        lines += [f"  {p!r}" for p in self._params.values()]
+        return "\n".join(lines)

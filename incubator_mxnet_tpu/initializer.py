@@ -1,0 +1,200 @@
+"""Weight initializers.
+
+Capability parity with reference ``python/mxnet/initializer.py``: registry of
+named initializers (``init.Xavier()``, string specs like ``"xavier"``),
+attribute-pattern dispatch (names ending in ``_bias`` → zeros, etc.), and
+serializable init descriptors stored in Parameter metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as np
+
+from . import random as _random
+from .ndarray import NDArray, array as nd_array
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(cls):
+        _REGISTRY[name.lower()] = cls
+        cls._alias = name.lower()
+        return cls
+    return deco
+
+
+def create(spec) -> "Initializer":
+    if isinstance(spec, Initializer):
+        return spec
+    if spec is None:
+        return Uniform(0.07)
+    if isinstance(spec, str):
+        name = spec.lower()
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown initializer {spec!r}")
+        return _REGISTRY[name]()
+    raise TypeError(f"cannot create initializer from {spec!r}")
+
+
+class Initializer:
+    """Base class. Subclasses implement ``_init_weight(name, shape, dtype)``
+    returning a numpy array; pattern-based dispatch mirrors the reference."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([getattr(self, "_alias", type(self).__name__.lower()),
+                           self._kwargs])
+
+    def __call__(self, name: str, shape, dtype=np.float32) -> np.ndarray:
+        if name.endswith(("_bias", "bias", "_beta", "beta",
+                          "running_mean", "moving_mean")):
+            return np.zeros(shape, dtype)
+        if name.endswith(("_gamma", "gamma", "running_var", "moving_var")):
+            return np.ones(shape, dtype)
+        return self._init_weight(name, shape, dtype)
+
+    def init_array(self, name, shape, dtype=np.float32) -> NDArray:
+        return nd_array(self(name, tuple(shape), np.float32).astype(dtype)
+                        if str(dtype) == "bfloat16"
+                        else self(name, tuple(shape), dtype))
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+
+def _rng():
+    # numpy generator seeded off the framework key for reproducibility
+    import jax
+
+    key = _random.next_key()
+    seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1]) & 0x7FFFFFFF
+    return np.random.default_rng(seed)
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return np.zeros(shape, dtype)
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return np.ones(shape, dtype)
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return np.full(shape, self.value, dtype)
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        return _rng().uniform(-self.scale, self.scale, shape).astype(dtype)
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        return (_rng().standard_normal(shape) * self.sigma).astype(dtype)
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape, dtype):
+        nout = shape[0]
+        nin = int(np.prod(shape[1:]))
+        rng = _rng()
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.standard_normal((nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+def _fan(shape, factor_type):
+    hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return float(fan_in)
+    return float(fan_out)
+
+
+@register("xavier")
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, shape, dtype):
+        factor = _fan(shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        rng = _rng()
+        if self.rnd_type == "uniform":
+            return rng.uniform(-scale, scale, shape).astype(dtype)
+        return (rng.standard_normal(shape) * scale).astype(dtype)
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("lecunn")
+class LeCunN(Xavier):
+    def __init__(self):
+        super().__init__("gaussian", "in", 1)
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    """Deconvolution bilinear-upsampling init (reference init.Bilinear)."""
+
+    def _init_weight(self, name, shape, dtype):
+        weight = np.zeros(shape, dtype)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        flat = weight.reshape(-1)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return flat.reshape(shape)

@@ -1,0 +1,291 @@
+"""``mx.nd`` — the imperative NDArray op namespace.
+
+Capability parity with reference ``python/mxnet/ndarray/`` where op wrappers
+are code-generated from the C registry at import time
+(``ndarray/register.py``). Here wrappers are generated from the pure-jax op
+registry; every call goes through ``invoke`` (the Imperative::Invoke analog)
+so autograd recording and naive-engine sync apply uniformly.
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+from types import ModuleType as _ModuleType
+
+from .ndarray import (NDArray, array, as_nd, arange, empty, eye, full, invoke,
+                      invoke_op, load, ones, ones_like, save, waitall, zeros,
+                      zeros_like)
+from ..ops import registry as _registry
+from ..ops import tensor as _t  # ensure registration  # noqa: F401
+from ..ops import nn as _nn  # noqa: F401
+from ..ops import random_ops as _r  # noqa: F401
+
+_this = _sys.modules[__name__]
+
+
+def _wrap(name, narr, variadic=False):
+    opdef = _registry.get(name)
+    assert opdef is not None, name
+
+    if variadic:
+        def op(*arrays, **kwargs):
+            return invoke(opdef.fn, arrays, kwargs, name=opdef.name,
+                          differentiable=opdef.differentiable,
+                          needs_rng=opdef.needs_rng)
+    else:
+        def op(*args, **kwargs):
+            arrays = args[:narr]
+            if len(args) > narr:
+                raise TypeError(
+                    f"{name} takes {narr} array arguments; pass options as "
+                    f"keywords")
+            return invoke(opdef.fn, arrays, kwargs, name=opdef.name,
+                          differentiable=opdef.differentiable,
+                          needs_rng=opdef.needs_rng)
+
+    op.__name__ = name
+    op.__doc__ = opdef.doc
+    return op
+
+
+# name -> number of NDArray positional args (None = variadic)
+_UNARY = [
+    "abs", "sign", "rint", "ceil", "floor", "trunc", "fix", "square", "sqrt",
+    "rsqrt", "cbrt", "rcbrt", "exp", "log", "log10", "log2", "log1p",
+    "expm1", "reciprocal", "negative", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "erf", "erfinv", "gamma", "gammaln", "digamma", "clip",
+    "isnan", "isinf", "isfinite", "sum", "mean", "prod", "nansum",
+    "nanprod", "max", "min", "argmax", "argmin", "norm", "cumsum",
+    "logsumexp", "reshape", "transpose", "expand_dims", "squeeze", "flip",
+    "reverse", "tile", "repeat", "pad", "depth_to_space", "space_to_depth",
+    "split", "sort", "argsort", "topk", "cast", "zeros_like", "ones_like",
+    "shape_array", "size_array", "diag", "broadcast_axis", "broadcast_to",
+    "softmax", "log_softmax", "relu", "sigmoid", "softsign", "softrelu",
+    "gelu", "silu", "mish", "hard_sigmoid", "Activation", "activation",
+    "l2_normalization", "L2Normalization", "adaptive_avg_pool2d",
+    "boolean_mask_unused",
+]
+_BINARY = [
+    "elemwise_add", "broadcast_add", "add", "elemwise_sub", "broadcast_sub",
+    "subtract", "elemwise_mul", "broadcast_mul", "multiply", "elemwise_div",
+    "broadcast_div", "divide", "broadcast_power", "power",
+    "broadcast_maximum", "maximum", "broadcast_minimum", "minimum",
+    "broadcast_mod", "mod", "broadcast_hypot", "broadcast_equal", "equal",
+    "broadcast_not_equal", "not_equal", "broadcast_greater", "greater",
+    "broadcast_greater_equal", "greater_equal", "broadcast_lesser", "lesser",
+    "broadcast_lesser_equal", "lesser_equal", "broadcast_logical_and",
+    "logical_and", "broadcast_logical_or", "logical_or",
+    "broadcast_logical_xor", "logical_xor", "dot", "batch_dot", "matmul",
+    "linalg_gemm2", "take", "pick", "gather_nd", "boolean_mask",
+    "slice_like", "sequence_mask", "sequence_last", "sequence_reverse",
+    "Embedding", "embedding", "one_hot_pair_unused",
+    "softmax_cross_entropy", "SoftmaxOutput", "softmax_output",
+]
+_TERNARY = ["where", "scatter_nd"]
+_VARIADIC = ["concat", "concatenate", "stack", "khatri_rao"]
+
+for _n in _UNARY:
+    if _registry.get(_n) is not None:
+        setattr(_this, _n, _wrap(_n, 1))
+for _n in _BINARY:
+    if _registry.get(_n) is not None:
+        setattr(_this, _n, _wrap(_n, 2))
+for _n in _TERNARY:
+    if _registry.get(_n) is not None:
+        setattr(_this, _n, _wrap(_n, 3))
+for _n in _VARIADIC:
+    if _registry.get(_n) is not None:
+        setattr(_this, _n, _wrap(_n, 0, variadic=True))
+
+# ops whose positional API differs from the generic wrapper ------------------
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import resolve_dtype
+
+    return invoke(_registry.get("one_hot").fn, [indices],
+                  dict(depth=depth, on_value=on_value, off_value=off_value,
+                       dtype=resolve_dtype(dtype)),
+                  name="one_hot", differentiable=False)
+
+
+def FullyConnected(data, weight, bias=None, **kwargs):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    if bias is None:
+        kwargs["no_bias"] = True
+
+    def fn(*arrs, **kw):
+        d, w = arrs[0], arrs[1]
+        b = arrs[2] if len(arrs) > 2 else None
+        return _registry.get("FullyConnected").fn(d, w, b, **kw)
+
+    return invoke(fn, args, kwargs, name="FullyConnected")
+
+
+def Convolution(data, weight, bias=None, **kwargs):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    if bias is None:
+        kwargs["no_bias"] = True
+
+    def fn(*arrs, **kw):
+        d, w = arrs[0], arrs[1]
+        b = arrs[2] if len(arrs) > 2 else None
+        return _registry.get("Convolution").fn(d, w, b, **kw)
+
+    return invoke(fn, args, kwargs, name="Convolution")
+
+
+def Deconvolution(data, weight, bias=None, **kwargs):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    if bias is None:
+        kwargs["no_bias"] = True
+
+    def fn(*arrs, **kw):
+        d, w = arrs[0], arrs[1]
+        b = arrs[2] if len(arrs) > 2 else None
+        return _registry.get("Deconvolution").fn(d, w, b, **kw)
+
+    return invoke(fn, args, kwargs, name="Deconvolution")
+
+
+def Pooling(data, **kwargs):
+    return invoke(_registry.get("Pooling").fn, [data], kwargs, name="Pooling")
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, **kwargs):
+    return invoke(_registry.get("BatchNorm").fn,
+                  [data, gamma, beta, moving_mean, moving_var], kwargs,
+                  name="BatchNorm")
+
+
+def LayerNorm(data, gamma, beta, **kwargs):
+    return invoke(_registry.get("LayerNorm").fn, [data, gamma, beta], kwargs,
+                  name="LayerNorm")
+
+
+def GroupNorm(data, gamma, beta, **kwargs):
+    return invoke(_registry.get("GroupNorm").fn, [data, gamma, beta], kwargs,
+                  name="GroupNorm")
+
+
+def InstanceNorm(data, gamma, beta, **kwargs):
+    return invoke(_registry.get("InstanceNorm").fn, [data, gamma, beta],
+                  kwargs, name="InstanceNorm")
+
+
+def rms_norm(data, gamma, **kwargs):
+    return invoke(_registry.get("RMSNorm").fn, [data, gamma], kwargs,
+                  name="RMSNorm")
+
+
+def Dropout(data, p=0.5, **kwargs):
+    from .. import autograd as _ag
+
+    kwargs["p"] = p
+    kwargs.setdefault("training", _ag.is_training())
+    return invoke(_registry.get("Dropout").fn, [data], kwargs, name="Dropout",
+                  needs_rng=True)
+
+
+def LeakyReLU(data, gamma=None, **kwargs):
+    if kwargs.get("act_type") == "prelu" and gamma is not None:
+        return invoke(lambda x, g, **kw: _registry.get("LeakyReLU").fn(
+            x, g, **kw), [data, gamma], kwargs, name="LeakyReLU")
+    return invoke(lambda x, **kw: _registry.get("LeakyReLU").fn(x, None, **kw),
+                  [data], kwargs, name="LeakyReLU")
+
+
+def scaled_dot_product_attention(q, k, v, mask=None, **kwargs):
+    args = [q, k, v] + ([mask] if mask is not None else [])
+
+    def fn(*arrs, **kw):
+        m = arrs[3] if len(arrs) > 3 else None
+        return _registry.get("scaled_dot_product_attention").fn(
+            arrs[0], arrs[1], arrs[2], m, **kw)
+
+    return invoke(fn, args, kwargs, name="sdpa")
+
+
+def slice(data, begin, end, step=None):  # noqa: A001 (mxnet name)
+    return data.slice(begin, end, step)
+
+
+def slice_axis(data, axis, begin, end):
+    return data.slice_axis(axis, begin, end)
+
+
+def swapaxes(data, dim1, dim2):
+    return data.swapaxes(dim1, dim2)
+
+
+def flatten(data):
+    return data.flatten()
+
+
+def stop_gradient(data):
+    return data.detach()
+
+
+BlockGrad = stop_gradient
+
+
+# ---------------------------------------------------------------------------
+# nd.random submodule (mx.nd.random.uniform(...) API)
+# ---------------------------------------------------------------------------
+random = _ModuleType(__name__ + ".random")
+
+
+def _wrap_sampler(name):
+    opdef = _registry.get(name)
+
+    def op(*args, **kwargs):
+        ctx = kwargs.pop("ctx", None)
+        out = invoke(opdef.fn, [], dict(zip(_SAMPLER_ARGS[name], args)) | kwargs,
+                     name=name, differentiable=False, needs_rng=True)
+        return out if ctx is None else out.as_in_context(ctx)
+
+    op.__name__ = name
+    return op
+
+
+_SAMPLER_ARGS = {
+    "uniform": ("low", "high", "shape"),
+    "normal": ("loc", "scale", "shape"),
+    "gamma_sample": ("alpha", "beta", "shape"),
+    "exponential": ("lam", "shape"),
+    "poisson": ("lam", "shape"),
+    "randint": ("low", "high", "shape"),
+    "bernoulli": ("prob", "shape"),
+}
+for _n in _SAMPLER_ARGS:
+    setattr(random, _n.replace("_sample", ""), _wrap_sampler(_n))
+random.gamma = _wrap_sampler("gamma_sample")
+
+
+def _multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    from ..base import resolve_dtype
+
+    return invoke(_registry.get("sample_multinomial").fn, [data],
+                  dict(shape=shape, get_prob=get_prob,
+                       dtype=resolve_dtype(dtype)),
+                  name="multinomial", differentiable=False, needs_rng=True)
+
+
+random.multinomial = _multinomial
+random.categorical = _multinomial
+
+
+def _shuffle(data):
+    return invoke(_registry.get("shuffle").fn, [data], {}, name="shuffle",
+                  differentiable=False, needs_rng=True)
+
+
+random.shuffle = _shuffle
+shuffle = _shuffle
+_sys.modules[random.__name__] = random
+
+# top-level sampler aliases (mx.nd.uniform etc.)
+uniform = random.uniform
+normal = random.normal
+random_normal = random.normal
+random_uniform = random.uniform
+sample_multinomial = random.multinomial

@@ -1,0 +1,7 @@
+"""Op registry package. Importing it registers the full op surface."""
+
+from . import registry
+from . import tensor  # noqa: F401  (registers tensor ops)
+from . import nn      # noqa: F401  (registers nn ops)
+from . import random_ops  # noqa: F401  (registers samplers)
+from .registry import get, list_ops, register  # noqa: F401

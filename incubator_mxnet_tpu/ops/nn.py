@@ -1,0 +1,383 @@
+"""Neural-network ops.
+
+Capability parity with reference ``src/operator/nn/`` (FullyConnected,
+Convolution/Deconvolution, Pooling, BatchNorm, LayerNorm, Activation,
+Dropout, Embedding, softmax family — SURVEY.md §2.1) where cuDNN/oneDNN
+provided the kernels. TPU-native redesign: every op is a pure jax function
+lowered by XLA onto the MXU (convs/matmuls) and VPU (elementwise); there is
+no algo-selection/autotune registry because XLA picks conv algorithms during
+compilation. Layout: the API is NCHW like the reference; XLA's layout
+assignment maps it to the TPU-preferred tiling internally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ---------------------------------------------------------------------------
+# Dense / conv / pooling
+# ---------------------------------------------------------------------------
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """Reference src/operator/nn/fully_connected.cc: y = x·Wᵀ + b.
+    Weight layout (num_hidden, in_units) matches the reference."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(x, weight, bias=None, kernel=None, stride=(1, 1), pad=(0, 0),
+                dilate=(1, 1), num_filter=None, num_group=1, no_bias=False,
+                layout="NCHW"):
+    """Reference src/operator/nn/convolution.cc (cuDNN path). NCHW in/out,
+    weight (O, I/g, kH, kW). Grouped conv via feature_group_count."""
+    stride, pad, dilate = _pair(stride), _pair(pad), _pair(dilate)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(x, weight, bias=None, kernel=None, stride=(1, 1),
+                  pad=(0, 0), adj=(0, 0), num_filter=None, num_group=1,
+                  no_bias=False):
+    """Transposed convolution (reference src/operator/nn/deconvolution.cc).
+    Weight (I, O/g, kH, kW) like the reference."""
+    stride, pad, adj = _pair(stride), _pair(pad), _pair(adj)
+    kh, kw = weight.shape[2], weight.shape[3]
+    pads = [(kh - 1 - pad[0], kh - 1 - pad[0] + adj[0]),
+            (kw - 1 - pad[1], kw - 1 - pad[1] + adj[1])]
+    if num_group != 1:
+        xs = jnp.split(x, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        ys = [_deconv_one(a, w, stride, pads) for a, w in zip(xs, ws)]
+        y = jnp.concatenate(ys, axis=1)
+    else:
+        y = _deconv_one(x, weight, stride, pads)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+def _deconv_one(x, weight, stride, pads):
+    w = jnp.flip(weight, (2, 3)).transpose(1, 0, 2, 3)  # -> (O, I, kH, kW)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
+        dimension_numbers=dn)
+
+
+@register("Pooling", aliases=("pooling",))
+def pooling(x, kernel=(2, 2), pool_type="max", stride=None, pad=(0, 0),
+            global_pool=False, count_include_pad=True, pooling_convention="valid"):
+    """Reference src/operator/nn/pooling.cc. NCHW."""
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(x, axis=(2, 3), keepdims=True)
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    kernel = _pair(kernel)
+    stride = _pair(stride) if stride is not None else kernel
+    pad = _pair(pad)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pooling_convention == "full":
+        # ceil-mode: extend right/bottom padding so the last window fits
+        extra = []
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+            n = x.shape[2 + i]
+            out = -(-(n + 2 * p - k) // s) + 1  # ceil
+            need = (out - 1) * s + k - (n + 2 * p)
+            extra.append(max(0, need))
+        padding = ((0, 0), (0, 0), (pad[0], pad[0] + extra[0]),
+                   (pad[1], pad[1] + extra[1]))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, dims, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / (kernel[0] * kernel[1])
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(x ** 2, 0.0, lax.add, dims, strides, padding)
+        return jnp.sqrt(s)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size=1):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5))
+
+
+# ---------------------------------------------------------------------------
+# Normalization (functional cores; stateful running stats live in Gluon)
+# ---------------------------------------------------------------------------
+@register("BatchNorm", aliases=("batch_norm",))
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, training=False):
+    """Reference src/operator/nn/batch_norm.cc semantics. In training mode
+    returns (out, batch_mean, batch_var) so the caller (Gluon BatchNorm)
+    can update running stats functionally — the XLA-friendly replacement
+    for the reference's in-kernel aux-state mutation."""
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    out = (x - mean.reshape(bshape)) * jax.lax.rsqrt(
+        var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+    if training and not use_global_stats:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def instance_norm(x, gamma, beta, eps=1e-3):
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return ((x - mean) * jax.lax.rsqrt(var + eps)
+            * gamma.reshape(bshape) + beta.reshape(bshape))
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("RMSNorm", aliases=("rms_norm",))
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """TPU-era addition (no reference analog; transformers need it)."""
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = x * jax.lax.rsqrt(ms + eps).astype(x.dtype)
+    return out * gamma
+
+
+@register("L2Normalization", aliases=("l2_normalization",))
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    return x / n
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+@register("Activation", aliases=("activation",))
+def activation(x, act_type="relu"):
+    return _ACTS[act_type](x)
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("softrelu", aliases=("softplus",))
+def softrelu(x):
+    return jax.nn.softplus(x)
+
+
+@register("gelu")
+def gelu(x, approximate=True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register("silu", aliases=("swish",))
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("LeakyReLU", aliases=("leaky_relu",))
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, rng=None):
+    """Reference src/operator/leaky_relu.cc: leaky/prelu/elu/selu/gelu/rrelu."""
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if gamma.ndim == 1 \
+            and x.ndim > 2 else gamma
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type}")
+
+
+_ACTS = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+         "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+         "gelu": jax.nn.gelu, "silu": jax.nn.silu,
+         "log_sigmoid": jax.nn.log_sigmoid, "mish": mish}
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+@register("softmax")
+def softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        pos = jnp.arange(x.shape[axis])
+        bshape = [1] * x.ndim
+        bshape[axis] = x.shape[axis]
+        mask = pos.reshape(bshape) < length.reshape(
+            [x.shape[0]] + [1] * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(logits, label):
+    """Reference src/operator/loss_binary_op.cc: summed CE with int labels."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        lp, label.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False, normalization="null"):
+    return jax.nn.softmax(data, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Dropout / Embedding
+# ---------------------------------------------------------------------------
+@register("Dropout", aliases=("dropout",), needs_rng=True)
+def dropout(x, p=0.5, mode="training", axes=(), rng=None, training=True):
+    """Reference src/operator/nn/dropout.cc (cuDNN dropout states ↔ explicit
+    jax PRNG keys)."""
+    if not training or p <= 0.0:
+        return x
+    shape = list(x.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(x.dtype)
+    return x * mask / keep
+
+
+@register("Embedding", aliases=("embedding",))
+def embedding(indices, weight, input_dim=None, output_dim=None,
+              dtype=None, sparse_grad=False):
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Attention (TPU-era addition; reference built attention from batch_dot)
+# ---------------------------------------------------------------------------
+@register("scaled_dot_product_attention")
+def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
+                                 causal=False):
+    """Batched multi-head attention core: q,k,v (B, H, T, D). XLA fuses this
+    chain; a Pallas flash-attention kernel replaces it for long sequences
+    (see parallel/ring_attention)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, -jnp.inf)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)

@@ -1,0 +1,80 @@
+"""Random sampling ops.
+
+Capability parity with reference ``src/operator/random/`` (sample_uniform /
+normal / gamma / poisson / negbinomial / multinomial, randint, shuffle;
+``mx.nd.random.*``). TPU-native: explicit jax PRNG keys drawn from the global
+state (random.py) per invocation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("random_uniform", differentiable=False, needs_rng=True,
+          aliases=("uniform", "sample_uniform"))
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.uniform(rng, tuple(shape), dtype, low, high)
+
+
+@register("random_normal", differentiable=False, needs_rng=True,
+          aliases=("normal", "sample_normal"))
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.normal(rng, tuple(shape), dtype) * scale + loc
+
+
+@register("random_gamma", differentiable=False, needs_rng=True,
+          aliases=("gamma_sample",))
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.gamma(rng, alpha, tuple(shape), dtype) * beta
+
+
+@register("random_exponential", differentiable=False, needs_rng=True,
+          aliases=("exponential",))
+def exponential(lam=1.0, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.exponential(rng, tuple(shape), dtype) / lam
+
+
+@register("random_poisson", differentiable=False, needs_rng=True,
+          aliases=("poisson",))
+def poisson(lam=1.0, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.poisson(rng, lam, tuple(shape)).astype(dtype)
+
+
+@register("random_randint", differentiable=False, needs_rng=True,
+          aliases=("randint",))
+def randint(low=0, high=10, shape=(1,), dtype=jnp.int32, rng=None):
+    return jax.random.randint(rng, tuple(shape), low, high, dtype)
+
+
+@register("random_bernoulli", differentiable=False, needs_rng=True,
+          aliases=("bernoulli",))
+def bernoulli(prob=0.5, shape=(1,), dtype=jnp.float32, rng=None):
+    return jax.random.bernoulli(rng, prob, tuple(shape)).astype(dtype)
+
+
+@register("sample_multinomial", differentiable=False, needs_rng=True,
+          aliases=("multinomial", "random_categorical"))
+def multinomial(data, shape=(), get_prob=False, dtype=jnp.int32, rng=None):
+    """data: (..., k) probabilities (reference sample_multinomial)."""
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1 if shape == () else int(jnp.prod(jnp.asarray(shape)))
+    out_shape = data.shape[:-1] if shape == () else data.shape[:-1] + tuple(
+        (shape,) if isinstance(shape, int) else shape)
+    idx = jax.random.categorical(
+        rng, logits, axis=-1,
+        shape=(() if shape == () else ((shape,) if isinstance(shape, int)
+                                       else tuple(shape))) + data.shape[:-1])
+    if shape != ():
+        nd_extra = len((shape,) if isinstance(shape, int) else shape)
+        idx = jnp.moveaxis(idx, tuple(range(nd_extra)),
+                           tuple(range(idx.ndim - nd_extra, idx.ndim)))
+    return idx.astype(dtype)
+
+
+@register("shuffle", differentiable=False, needs_rng=True)
+def shuffle(x, rng=None):
+    return jax.random.permutation(rng, x, axis=0)

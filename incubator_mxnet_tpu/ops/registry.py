@@ -1,0 +1,56 @@
+"""Operator registry.
+
+Capability parity with the reference's nnvm op registry
+(``NNVM_REGISTER_OP`` + ``FCompute`` attrs, SURVEY.md §2.1 "Operator
+library") and the ``dmlc::Parameter`` docstring generation.
+
+TPU-native redesign: an op is a *pure jax function* over jax arrays. There is
+no FInferShape/FInferType — jax's abstract evaluation provides shape/dtype
+inference for free; there is no FGradient table — ``jax.vjp`` differentiates
+any registered op. The registry's remaining jobs are (1) the name→op lookup
+that generates the ``mx.nd.*`` surface, (2) per-op metadata (docs, whether the
+op is differentiable, how it consumes RNG), (3) the introspection surface
+(``list_ops``) that the opperf-style benchmark harness iterates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable[..., Any]          # pure: (*jax_arrays, **kwargs) -> array | tuple
+    differentiable: bool = True
+    needs_rng: bool = False          # fn takes kwarg rng=<jax PRNG key>
+    aliases: tuple = ()
+    doc: str = ""
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register(name: str, *, differentiable: bool = True, needs_rng: bool = False,
+             aliases: tuple = ()) -> Callable:
+    """Register a pure jax function as a framework op."""
+
+    def deco(fn: Callable) -> Callable:
+        opdef = OpDef(name=name, fn=fn, differentiable=differentiable,
+                      needs_rng=needs_rng, aliases=aliases, doc=fn.__doc__ or "")
+        _OPS[name] = opdef
+        for a in aliases:
+            _OPS[a] = opdef
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Optional[OpDef]:
+    return _OPS.get(name)
+
+
+def list_ops():
+    """All registered canonical op names (for opperf-style sweeps)."""
+    return sorted({od.name for od in _OPS.values()})

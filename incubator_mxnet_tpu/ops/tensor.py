@@ -1,0 +1,485 @@
+"""Tensor ops: arithmetic, broadcast, reduce, shape, index manipulation.
+
+Capability parity with reference ``src/operator/tensor/`` (elemwise_*,
+broadcast_*, reduce, matrix_op, indexing_op, ordering_op — SURVEY.md §2.1
+"Operator library"). Pure jax functions; MXU-friendly by construction (jnp
+ops lower to XLA HLO which tiles onto the MXU/VPU). Accumulation for reduced
+precision follows MXTPU_SAFE_ACCUMULATION (reference MXNET_SAFE_ACCUMULATION).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import config
+from .registry import register
+
+
+def _acc_dtype(x):
+    if config.get("MXTPU_SAFE_ACCUMULATION") and x.dtype in (
+            jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+# -- elementwise binary ------------------------------------------------------
+@register("elemwise_add", aliases=("broadcast_add", "add"))
+def add(a, b):
+    return a + b
+
+
+@register("elemwise_sub", aliases=("broadcast_sub", "subtract"))
+def sub(a, b):
+    return a - b
+
+
+@register("elemwise_mul", aliases=("broadcast_mul", "multiply"))
+def mul(a, b):
+    return a * b
+
+
+@register("elemwise_div", aliases=("broadcast_div", "divide"))
+def div(a, b):
+    return a / b
+
+
+@register("broadcast_power", aliases=("power",))
+def power(a, b):
+    return a ** b
+
+
+@register("broadcast_maximum", aliases=("maximum",))
+def maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+@register("broadcast_minimum", aliases=("minimum",))
+def minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+@register("broadcast_mod", aliases=("mod",))
+def mod(a, b):
+    return a % b
+
+
+@register("broadcast_hypot")
+def hypot(a, b):
+    return jnp.hypot(a, b)
+
+
+# comparisons ---------------------------------------------------------------
+for _name, _fn in [
+    ("equal", lambda a, b: (a == b)),
+    ("not_equal", lambda a, b: (a != b)),
+    ("greater", lambda a, b: (a > b)),
+    ("greater_equal", lambda a, b: (a >= b)),
+    ("lesser", lambda a, b: (a < b)),
+    ("lesser_equal", lambda a, b: (a <= b)),
+    ("logical_and", lambda a, b: jnp.logical_and(a != 0, b != 0)),
+    ("logical_or", lambda a, b: jnp.logical_or(a != 0, b != 0)),
+    ("logical_xor", lambda a, b: jnp.logical_xor(a != 0, b != 0)),
+]:
+    register("broadcast_" + _name, differentiable=False,
+             aliases=(_name,))(
+        (lambda f: lambda a, b: f(a, b).astype(a.dtype))(_fn))
+
+
+# -- elementwise unary -------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x), "exp": jnp.exp,
+    "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1, "reciprocal": lambda x: 1.0 / x,
+    "negative": lambda x: -x,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+}
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x).astype(jnp.float32)
+
+
+@register("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x).astype(jnp.float32)
+
+
+@register("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x).astype(jnp.float32)
+
+
+# -- reductions --------------------------------------------------------------
+def _reduce(jfn):
+    def f(x, axis=None, keepdims=False, exclude=False):
+        if exclude and axis is not None:
+            ax = (axis,) if isinstance(axis, int) else tuple(axis)
+            axis = tuple(i for i in range(x.ndim) if i not in ax)
+        acc = _acc_dtype(x)
+        if acc is not None and jfn in (jnp.sum, jnp.mean, jnp.prod):
+            return jfn(x, axis=axis, keepdims=keepdims, dtype=acc).astype(x.dtype)
+        return jfn(x, axis=axis, keepdims=keepdims)
+    return f
+
+
+register("sum", aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+register("max", aliases=("max_axis",))(_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_reduce(jnp.min))
+
+
+@register("argmax", differentiable=False)
+def argmax(x, axis=None, keepdims=False):
+    r = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(x, axis=None, keepdims=False):
+    r = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r.astype(jnp.float32)
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    if axis is None:
+        x = x.reshape(-1)
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("cumsum")
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register("logsumexp")
+def logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+# -- linear algebra ----------------------------------------------------------
+@register("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference ``mx.nd.dot`` (src/operator/tensor/dot*): last axis of a
+    with first axis of b; lowers straight onto the MXU."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out
+
+
+# -- shape manipulation ------------------------------------------------------
+@register("reshape")
+def reshape(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+@register("transpose")
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("flip", aliases=("reverse",))
+def flip(x, axis=0):
+    return jnp.flip(x, axis)
+
+
+@register("tile")
+def tile(x, reps=(1,)):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad")
+def pad(x, pad_width=None, mode="constant", constant_value=0.0):
+    return jnp.pad(x, pad_width, mode=mode,
+                   **({"constant_values": constant_value}
+                      if mode == "constant" else {}))
+
+
+@register("depth_to_space")
+def depth_to_space(x, block_size=2):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(x, block_size=2):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 5, 3, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+# -- joining / splitting -----------------------------------------------------
+@register("concat", aliases=("concatenate",))
+def concat(*arrays, dim=1):
+    return jnp.concatenate(arrays, axis=dim)
+
+
+@register("stack")
+def stack(*arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("split", aliases=("split_v2",))
+def split(x, num_outputs=None, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# -- indexing ----------------------------------------------------------------
+@register("take")
+def take(x, indices, axis=0, mode="clip"):
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register("pick")
+def pick(x, index, axis=-1, keepdims=False):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis >= 0 else x.ndim - 1)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd")
+def gather_nd(x, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return x[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    return jnp.zeros(shape, data.dtype).at[idx].set(data)
+
+
+@register("where")
+def where(cond, a, b):
+    return jnp.where(cond != 0 if cond.dtype.kind == "f" else cond, a, b)
+
+
+@register("boolean_mask", differentiable=False)
+def boolean_mask(x, mask):
+    # dynamic-shape op: materialize on host semantics; jit-unfriendly by
+    # nature (same caveat as reference sparse paths)
+    return x[mask.astype(bool)]
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype=jnp.float32):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("slice_like")
+def slice_like(x, shape_like, axes=()):
+    tgt = shape_like.shape
+    idx = [slice(None)] * x.ndim
+    axes = axes or range(x.ndim)
+    for ax in axes:
+        idx[ax] = slice(0, tgt[ax])
+    return x[tuple(idx)]
+
+
+@register("sequence_mask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=True,
+                  value=0.0, axis=0):
+    """Reference src/operator/sequence_mask. data: (seq, batch, ...) when
+    axis=0."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_len = data.shape[axis]
+    pos = jnp.arange(seq_len)
+    shape = [1] * data.ndim
+    shape[axis] = seq_len
+    pos = pos.reshape(shape)
+    batch_axis = 1 if axis == 0 else 0
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    mask = pos < sequence_length.reshape(lshape)
+    return jnp.where(mask, data, value)
+
+
+@register("sequence_last")
+def sequence_last(data, sequence_length=None, use_sequence_length=True, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = -1
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    moved = jnp.moveaxis(data, axis, 0)  # (seq, batch, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=True,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    seq = moved.shape[0]
+    pos = jnp.arange(seq)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < L, L - 1 - pos, pos)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# -- ordering ----------------------------------------------------------------
+@register("sort")
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype=jnp.float32):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype)
+
+
+@register("topk", differentiable=False)
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype=jnp.float32):
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(dtype)
+    return idx.astype(dtype)
+
+
+# -- casting / misc ----------------------------------------------------------
+@register("cast", aliases=("Cast",))
+def cast(x, dtype=jnp.float32):
+    return jnp.asarray(x, dtype)
+
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.asarray(x.shape, jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def size_array(x):
+    return jnp.asarray([x.size], jnp.int32)
+
+
+@register("diag")
+def diag(x, k=0):
+    return jnp.diag(x, k) if x.ndim <= 2 else jnp.diagonal(x, k, -2, -1)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=None):
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)

@@ -1,0 +1,52 @@
+"""Global random state.
+
+Capability parity with reference ``python/mxnet/random.py`` +
+``include/mxnet/random_generator.h`` (SURVEY.md §2.1 "Resource manager"):
+global + per-device seeding, with every op drawing fresh randomness.
+
+TPU-native redesign: jax PRNG is explicit-key/functional, so the global state
+is a root key plus a monotonically increasing fold-in counter. Each imperative
+random op consumes ``next_key()`` — deterministic given the seed and call
+sequence, which also preserves the reference's "seed then replay" test
+discipline (``MXNET_TEST_SEED``). Inside traced/jitted code (hybridize), keys
+are threaded explicitly by the CachedOp machinery instead of drawn here.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _RandomState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.counter = 0
+
+
+_rs = _RandomState()
+
+
+def seed(seed_state: int, ctx: str = "all") -> None:
+    """Seed the global generator (reference ``mx.random.seed``).
+
+    ``ctx`` accepted for API parity; jax keys are device-agnostic.
+    """
+    _rs.key = jax.random.PRNGKey(int(seed_state))
+    _rs.counter = 0
+
+
+def next_key():
+    """Draw a fresh PRNG key for one op invocation."""
+    _rs.counter += 1
+    return jax.random.fold_in(_rs.key, _rs.counter)
+
+
+def current_key():
+    return _rs.key
+
+
+# Convenience samplers mirroring mx.random.* are installed by the ndarray
+# package (they are ordinary registered ops: uniform, normal, randint, ...).

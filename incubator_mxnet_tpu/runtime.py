@@ -1,0 +1,74 @@
+"""Runtime feature introspection.
+
+Capability parity with reference ``src/libinfo.cc`` + ``python/mxnet/runtime.py``
+(``mx.runtime.feature_list()``, ``Features().is_enabled('CUDA')``): the build
+flags become runtime-discovered properties of the jax install.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class Feature:
+    name: str
+    enabled: bool
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    import jax
+
+    feats = {}
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except RuntimeError:
+        platforms = set()
+    feats["TPU"] = any(p not in ("cpu",) for p in platforms)
+    feats["CPU"] = True
+    feats["CUDA"] = "gpu" in platforms or "cuda" in platforms
+    feats["XLA"] = True
+    feats["PALLAS"] = True
+    feats["BF16"] = True
+    feats["INT64_TENSOR_SIZE"] = jax.config.jax_enable_x64
+    feats["DIST_KVSTORE"] = True      # jax.distributed-backed kvstore facade
+    feats["SHARDED_CHECKPOINT"] = _has_module("orbax") or _has_module(
+        "tensorstore")
+    feats["PROFILER"] = True          # jax.profiler / XPlane
+    feats["OPENCV"] = _has_module("cv2")
+    feats["RECORDIO_NATIVE"] = _native_recordio_available()
+    feats["AMP"] = True
+    return feats
+
+
+def _has_module(name: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(name) is not None
+
+
+def _native_recordio_available() -> bool:
+    import os
+
+    here = os.path.dirname(__file__)
+    for n in ("libmxtpu_io.so",):
+        if os.path.exists(os.path.join(here, "native", n)):
+            return True
+    return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _detect().items()})
+
+    def is_enabled(self, name: str) -> bool:
+        f = self.get(name)
+        return bool(f and f.enabled)
+
+
+def feature_list() -> List[Feature]:
+    return list(Features().values())

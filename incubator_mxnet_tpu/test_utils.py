@@ -1,0 +1,128 @@
+"""Testing substrate.
+
+Capability parity with reference ``python/mxnet/test_utils.py`` (SURVEY.md §4
+"Key testing ideas"): numpy as oracle with dtype-aware tolerances
+(``assert_almost_equal``), finite-difference gradient checking independent of
+autograd (``check_numeric_gradient``), cross-context consistency
+(``check_consistency`` — cpu jax backend vs tpu), and random test data
+(``rand_ndarray``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import autograd
+from .device import Context, cpu, num_tpus, tpu
+from .ndarray import NDArray, array as nd_array
+
+_DTYPE_TOL = {
+    np.dtype(np.float64): (1e-12, 1e-12),
+    np.dtype(np.float32): (1e-5, 1e-6),
+    np.dtype(np.float16): (1e-2, 1e-3),
+}
+
+
+def default_rtol_atol(*dtypes):
+    rtol, atol = 1e-5, 1e-6
+    for dt in dtypes:
+        name = getattr(dt, "name", str(dt))
+        if name == "bfloat16":
+            rtol, atol = max(rtol, 2e-2), max(atol, 2e-2)
+            continue
+        t = _DTYPE_TOL.get(np.dtype(dt) if not hasattr(dt, "name") or
+                           name != "bfloat16" else None)
+        if t:
+            rtol, atol = max(rtol, t[0]), max(atol, t[1])
+    return rtol, atol
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(getattr(a, "dtype", a_np.dtype),
+                                 getattr(b, "dtype", b_np.dtype))
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    np.testing.assert_allclose(
+        a_np.astype(np.float64) if a_np.dtype.kind == "V" or
+        str(a_np.dtype) == "bfloat16" else a_np,
+        b_np.astype(np.float64) if b_np.dtype.kind == "V" or
+        str(b_np.dtype) == "bfloat16" else b_np,
+        rtol=rtol, atol=atol,
+        err_msg=f"{names[0]} vs {names[1]} mismatch")
+
+
+def rand_ndarray(shape, ctx: Optional[Context] = None, dtype=np.float32,
+                 low=-1.0, high=1.0) -> NDArray:
+    data = np.random.uniform(low, high, size=shape).astype(dtype)
+    return nd_array(data, ctx=ctx)
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3) -> None:
+    """Compare autograd gradients of scalar-valued ``fn`` against central
+    finite differences (reference ``check_numeric_gradient``)."""
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        assert out.size == 1, "check_numeric_gradient needs a scalar output"
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        base = x.asnumpy().astype(np.float64)
+        numeric = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            for sign in (+1, -1):
+                pert = flat.copy()
+                pert[j] += sign * eps
+                x._set_data(pert.reshape(base.shape).astype(base.dtype))
+                val = float(fn(*inputs).asnumpy().reshape(()))
+                num_flat[j] += sign * val / (2 * eps)
+        x._set_data(base)
+        np.testing.assert_allclose(
+            analytic[xi], numeric, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for input {xi}")
+
+
+def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
+                      ctx_list: Optional[List[Context]] = None,
+                      rtol=None, atol=None) -> None:
+    """Run ``fn`` under several contexts and compare results (reference
+    cross-ctx ``check_consistency``; cpu jax backend is the second oracle)."""
+    ctx_list = ctx_list or default_ctx_list()
+    results = []
+    for ctx in ctx_list:
+        args = [nd_array(x, ctx=ctx) for x in inputs_np]
+        out = fn(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for got, ctx in zip(results[1:], ctx_list[1:]):
+        for r, g in zip(ref, got):
+            assert_almost_equal(r, g, rtol=rtol, atol=atol,
+                                names=(str(ctx_list[0]), str(ctx)))
+
+
+def default_ctx_list() -> List[Context]:
+    ctxs = [cpu()]
+    if num_tpus() > 0:
+        ctxs.append(tpu())
+    return ctxs
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_to_np(a), _to_np(b))

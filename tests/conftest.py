@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Reference test strategy (SURVEY.md §4): one suite, many contexts; numpy as
+oracle; seed discipline via MXNET_TEST_SEED. Multi-chip tests run on a
+virtual 8-device CPU mesh (``xla_force_host_platform_device_count``), the
+analog of the reference's multi-process-on-one-box launcher tests.
+"""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Seed discipline: every test runs with a logged, overridable seed
+    (reference @with_seed / MXNET_TEST_SEED)."""
+    seed = int(os.environ.get("MXTPU_TEST_SEED",
+                              os.environ.get("MXNET_TEST_SEED", "42")))
+    np.random.seed(seed)
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield

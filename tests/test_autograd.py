@@ -1,0 +1,168 @@
+"""Autograd tape tests (reference tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_record_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_simple_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 4 * np.array([1.0, 2.0, 3.0]))
+
+
+def test_chain_and_branches():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = a + x          # x used twice
+        y = (b * b).sum()
+    y.backward()
+    # y = (3x)^2 summed -> dy/dx = 18x
+    assert_almost_equal(x.grad, 18 * x.asnumpy())
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.nd.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, np.array([3.0, 30.0]))
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * x.asnumpy())
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()  # write
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_multiple_heads():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    autograd.backward([y1, y2])
+    assert_almost_equal(x.grad, np.array([5.0]))
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (gx,) = autograd.grad(y, [x])
+    assert_almost_equal(gx, np.array([6.0]))
+    # .grad untouched by grad()
+    assert_almost_equal(x.grad, np.zeros(1))
+
+
+def test_higher_order_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # y = x^3
+        gx = autograd.grad(y, [x], create_graph=True)[0]  # 3x^2
+        z = gx.sum()
+    z.backward()
+    assert_almost_equal(x.grad, np.array([12.0]))  # d(3x^2)/dx = 6x
+
+
+def test_mark_variables():
+    x = mx.nd.array([1.0, 2.0])
+    g = mx.nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.full(2, 5.0))
+
+
+def test_no_record_no_grad():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # outside record
+    with pytest.raises(ValueError):
+        y.backward()
+
+
+def test_pause_excludes_ops():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            c = y * 100  # not recorded
+        z = (y + c.detach() * 0).sum()
+    z.backward()
+    assert_almost_equal(x.grad, np.array([2.0]))
+
+
+def test_custom_function():
+    class MySquare(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = mx.nd.array([3.0, 4.0])
+    x.attach_grad()
+    f = MySquare()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_training_mode_flags():
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_exception_surfacing():
+    # async errors surface at sync points (reference engine exception rethrow)
+    x = mx.nd.array([1.0])
+    y = nd.log(x * -1.0)  # nan, not an error — check nan propagates
+    assert np.isnan(float(y))

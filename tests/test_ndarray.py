@@ -1,0 +1,183 @@
+"""NDArray semantics tests (reference tests/python/unittest/test_ndarray.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = mx.nd.full((2, 2), 7.0)
+    assert_almost_equal(c, np.full((2, 2), 7.0))
+    d = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(d, np.arange(0, 10, 2, dtype=np.float32))
+    e = mx.nd.array([[1, 2], [3, 4]])
+    assert e.dtype == np.int32  # int source keeps (narrowed) int dtype
+    f = mx.nd.array([[1.0, 2.0]])
+    assert f.dtype == np.float32
+
+
+def test_arithmetic():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(3, 4).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np)
+    assert_almost_equal(a + 2, a_np + 2)
+    assert_almost_equal(2 - a, 2 - a_np)
+    assert_almost_equal(a ** 2, a_np ** 2)
+    assert_almost_equal(-a, -a_np)
+    assert_almost_equal(abs(-a), np.abs(a_np))
+    assert_almost_equal(a @ b.T, a_np @ b_np.T)
+
+
+def test_inplace_rebinding():
+    a = mx.nd.ones((2, 2))
+    orig = a
+    a += 1
+    assert a is orig  # handle preserved
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+    a *= 3
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+
+
+def test_indexing():
+    a_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a[1], a_np[1])
+    assert_almost_equal(a[:, 1:3], a_np[:, 1:3])
+    assert_almost_equal(a[1, 2, 3], a_np[1, 2, 3])
+    a[0, 0] = 99.0
+    a_np[0, 0] = 99.0
+    assert_almost_equal(a, a_np)
+    a[:, 0, :] = mx.nd.zeros((2, 4))
+    a_np[:, 0, :] = 0
+    assert_almost_equal(a, a_np)
+
+
+def test_fancy_indexing():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = mx.nd.array(a_np)
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert_almost_equal(a[idx], a_np[[0, 2]])
+
+
+def test_shape_ops():
+    a_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(a_np)
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape(-1).shape == (24,)
+    assert a.reshape(0, -1).shape == (2, 12)  # MXNet magic 0 = copy dim
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.swapaxes(0, 1).shape == (3, 2, 4)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.flatten().shape == (2, 12)
+    assert_almost_equal(a.T, a_np.T)
+
+
+def test_slice_ops():
+    a_np = np.arange(20, dtype=np.float32).reshape(4, 5)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a.slice((1, 0), (3, 4)), a_np[1:3, 0:4])
+    assert_almost_equal(a.slice_axis(1, 1, 4), a_np[:, 1:4])
+
+
+def test_reductions():
+    a_np = np.random.rand(3, 4, 5).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a.sum(), a_np.sum())
+    assert_almost_equal(a.sum(axis=1), a_np.sum(axis=1))
+    assert_almost_equal(a.mean(axis=(0, 2)), a_np.mean(axis=(0, 2)))
+    assert_almost_equal(a.max(axis=2, keepdims=True),
+                        a_np.max(axis=2, keepdims=True))
+    assert_almost_equal(a.argmax(axis=1),
+                        a_np.argmax(axis=1).astype(np.float32))
+
+
+def test_astype_copy():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, np.ones((2, 2)))
+
+
+def test_copyto_context():
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    assert_almost_equal(b, np.ones((2, 2)))
+    c = a.as_in_context(mx.cpu())
+    assert c.ctx.kind == "cpu"
+
+
+def test_wait_and_scalar():
+    a = mx.nd.ones((1,))
+    a.wait_to_read()
+    assert float(a) == 1.0
+    assert int(mx.nd.array([3], dtype="int32").asscalar()) == 3
+    mx.nd.waitall()
+
+
+def test_comparison_ops():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a == b, np.array([0.0, 1.0, 0.0]))
+    assert_almost_equal(a > b, np.array([0.0, 0.0, 1.0]))
+    assert_almost_equal(a <= b, np.array([1.0, 1.0, 0.0]))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "test.params")
+    a = mx.nd.array(np.random.rand(3, 4).astype(np.float32))
+    mx.nd.save(fname, a)
+    loaded = mx.nd.load(fname)
+    assert_almost_equal(a, loaded)
+
+    lst = [mx.nd.ones((2,)), mx.nd.zeros((3, 3))]
+    mx.nd.save(fname, lst)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert_almost_equal(loaded[1], np.zeros((3, 3)))
+
+    d = {"w": mx.nd.ones((2, 2)), "b": mx.nd.zeros((2,))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], np.ones((2, 2)))
+
+
+def test_context_stack():
+    assert mx.current_context().device_type == "cpu"
+    with mx.Context("cpu", 0):
+        assert mx.current_context() == mx.cpu(0)
+    a = mx.nd.ones((1,), ctx=mx.cpu())
+    assert a.ctx == mx.cpu()
+
+
+def test_dtype_bf16():
+    a = mx.nd.ones((16, 16), dtype="bfloat16")
+    b = (a * 2).sum()
+    assert float(b) == 512.0
+
+
+def test_detach_blocks_grad():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * 2
+        z = (y.detach() * x).sum()
+    z.backward()
+    # d/dx of (2*const)*x = 2
+    assert_almost_equal(x.grad, np.full((2,), 2.0))

@@ -1,0 +1,295 @@
+"""Operator correctness vs numpy oracle
+(reference tests/python/unittest/test_operator.py)."""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient,
+                                            rand_ndarray)
+
+
+def _rnd(*shape, low=-1.0, high=1.0):
+    return np.random.uniform(low, high, size=shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("name,np_fn,low,high", [
+    ("exp", np.exp, -2, 2),
+    ("log", np.log, 0.1, 5),
+    ("sqrt", np.sqrt, 0.01, 4),
+    ("square", np.square, -3, 3),
+    ("abs", np.abs, -3, 3),
+    ("sign", np.sign, -3, 3),
+    ("floor", np.floor, -3, 3),
+    ("ceil", np.ceil, -3, 3),
+    ("rint", np.rint, -3, 3),
+    ("sin", np.sin, -3, 3),
+    ("cos", np.cos, -3, 3),
+    ("tanh", np.tanh, -3, 3),
+    ("arcsin", np.arcsin, -0.9, 0.9),
+    ("arctan", np.arctan, -3, 3),
+    ("log1p", np.log1p, -0.5, 3),
+    ("expm1", np.expm1, -2, 2),
+    ("erf", sps.erf, -2, 2),
+    ("gammaln", sps.gammaln, 0.5, 5),
+])
+def test_unary(name, np_fn, low, high):
+    x_np = _rnd(3, 4, low=low, high=high)
+    out = getattr(nd, name)(mx.nd.array(x_np))
+    assert_almost_equal(out, np_fn(x_np), rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("broadcast_add", np.add),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_power", np.power),
+])
+def test_binary_broadcast(name, np_fn):
+    a_np = _rnd(2, 3, 4)
+    b_np = _rnd(1, 3, 1)
+    if "power" in name:
+        a_np = np.abs(a_np) + 0.5
+    out = getattr(nd, name)(mx.nd.array(a_np), mx.nd.array(b_np))
+    assert_almost_equal(out, np_fn(a_np, b_np), rtol=1e-4, atol=1e-5)
+
+
+def test_dot():
+    a_np, b_np = _rnd(3, 4), _rnd(4, 5)
+    assert_almost_equal(nd.dot(mx.nd.array(a_np), mx.nd.array(b_np)),
+                        a_np @ b_np, rtol=1e-4)
+    # transpose flags
+    assert_almost_equal(
+        nd.dot(mx.nd.array(a_np), mx.nd.array(b_np.T), transpose_b=True),
+        a_np @ b_np, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(mx.nd.array(a_np.T), mx.nd.array(b_np), transpose_a=True),
+        a_np @ b_np, rtol=1e-4)
+
+
+def test_batch_dot():
+    a_np, b_np = _rnd(5, 3, 4), _rnd(5, 4, 2)
+    assert_almost_equal(nd.batch_dot(mx.nd.array(a_np), mx.nd.array(b_np)),
+                        np.matmul(a_np, b_np), rtol=1e-4)
+
+
+def test_concat_stack_split():
+    a_np, b_np = _rnd(2, 3), _rnd(2, 3)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(nd.concat(a, b, dim=1),
+                        np.concatenate([a_np, b_np], axis=1))
+    assert_almost_equal(nd.stack(a, b, axis=0), np.stack([a_np, b_np]))
+    parts = nd.split(mx.nd.array(_rnd(4, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (4, 2)
+
+
+def test_take_pick_gather():
+    x_np = _rnd(5, 4)
+    x = mx.nd.array(x_np)
+    idx = mx.nd.array([0, 3], dtype="int32")
+    assert_almost_equal(nd.take(x, idx), x_np[[0, 3]])
+    pick_idx = mx.nd.array([0, 1, 2, 3, 0], dtype="int32")
+    assert_almost_equal(nd.pick(x, pick_idx, axis=1),
+                        x_np[np.arange(5), [0, 1, 2, 3, 0]])
+
+
+def test_where_clip():
+    a_np = _rnd(3, 3)
+    cond = (a_np > 0).astype(np.float32)
+    out = nd.where(mx.nd.array(cond), mx.nd.array(a_np),
+                   mx.nd.array(-a_np))
+    assert_almost_equal(out, np.where(cond > 0, a_np, -a_np))
+    assert_almost_equal(nd.clip(mx.nd.array(a_np), a_min=-0.5, a_max=0.5),
+                        np.clip(a_np, -0.5, 0.5))
+
+
+def test_one_hot():
+    idx = mx.nd.array([0, 2, 1], dtype="int32")
+    out = nd.one_hot(idx, 4)
+    expect = np.eye(4, dtype=np.float32)[[0, 2, 1]]
+    assert_almost_equal(out, expect)
+
+
+def test_ordering():
+    x_np = _rnd(3, 6)
+    x = mx.nd.array(x_np)
+    assert_almost_equal(nd.sort(x, axis=1), np.sort(x_np, axis=1))
+    assert_almost_equal(nd.argsort(x, axis=1),
+                        np.argsort(x_np, axis=1).astype(np.float32))
+    vals = nd.topk(x, k=2, axis=1, ret_typ="value")
+    expect = -np.sort(-x_np, axis=1)[:, :2]
+    assert_almost_equal(vals, expect)
+
+
+def test_softmax_family():
+    x_np = _rnd(4, 7)
+    x = mx.nd.array(x_np)
+    e = np.exp(x_np - x_np.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    assert_almost_equal(nd.softmax(x), sm, rtol=1e-4)
+    assert_almost_equal(nd.log_softmax(x), np.log(sm), rtol=1e-4)
+
+
+def test_fully_connected():
+    x_np, w_np, b_np = _rnd(5, 8), _rnd(3, 8), _rnd(3)
+    out = nd.FullyConnected(mx.nd.array(x_np), mx.nd.array(w_np),
+                            mx.nd.array(b_np), num_hidden=3)
+    assert_almost_equal(out, x_np @ w_np.T + b_np, rtol=1e-4)
+    out = nd.FullyConnected(mx.nd.array(x_np), mx.nd.array(w_np),
+                            num_hidden=3)
+    assert_almost_equal(out, x_np @ w_np.T, rtol=1e-4)
+
+
+def test_convolution_vs_scipy():
+    # 1x1 conv == pointwise matmul (cheap oracle)
+    x_np = _rnd(2, 3, 5, 5)
+    w_np = _rnd(4, 3, 1, 1)
+    out = nd.Convolution(mx.nd.array(x_np), mx.nd.array(w_np),
+                         kernel=(1, 1), num_filter=4)
+    expect = np.einsum("nchw,oc->nohw", x_np, w_np[:, :, 0, 0])
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_identity():
+    # identity kernel passes input through
+    x_np = _rnd(1, 1, 4, 4)
+    w_np = np.zeros((1, 1, 3, 3), np.float32)
+    w_np[0, 0, 1, 1] = 1.0
+    out = nd.Convolution(mx.nd.array(x_np), mx.nd.array(w_np),
+                         kernel=(3, 3), pad=(1, 1), num_filter=1)
+    assert_almost_equal(out, x_np, rtol=1e-5)
+
+
+def test_pooling():
+    x_np = _rnd(1, 2, 4, 4)
+    x = mx.nd.array(x_np)
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expect = x_np.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, expect)
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect = x_np.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, expect, rtol=1e-5)
+    out = nd.Pooling(x, global_pool=True, pool_type="avg")
+    assert_almost_equal(out, x_np.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+
+
+def test_batch_norm_inference():
+    x_np = _rnd(4, 3, 2, 2)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mean, var = x_np.mean(axis=(0, 2, 3)), x_np.var(axis=(0, 2, 3))
+    out = nd.BatchNorm(mx.nd.array(x_np), mx.nd.array(gamma),
+                       mx.nd.array(beta), mx.nd.array(mean),
+                       mx.nd.array(var), eps=1e-5)
+    expect = (x_np - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm():
+    x_np = _rnd(4, 6)
+    g, b = np.ones(6, np.float32), np.zeros(6, np.float32)
+    out = nd.LayerNorm(mx.nd.array(x_np), mx.nd.array(g), mx.nd.array(b))
+    mu = x_np.mean(-1, keepdims=True)
+    sd = np.sqrt(x_np.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x_np - mu) / sd, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    w_np = _rnd(10, 4)
+    idx = mx.nd.array([1, 3, 1], dtype="int32")
+    out = nd.Embedding(idx, mx.nd.array(w_np), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w_np[[1, 3, 1]])
+
+
+def test_activations():
+    x_np = _rnd(3, 4, low=-3, high=3)
+    x = mx.nd.array(x_np)
+    assert_almost_equal(nd.relu(x), np.maximum(x_np, 0))
+    assert_almost_equal(nd.sigmoid(x), 1 / (1 + np.exp(-x_np)), rtol=1e-4)
+    assert_almost_equal(nd.softrelu(x), np.log1p(np.exp(x_np)), rtol=1e-4)
+    assert_almost_equal(nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+                        np.where(x_np > 0, x_np, 0.1 * x_np))
+
+
+def test_sequence_ops():
+    data = _rnd(4, 2, 3)  # (seq, batch, feat)
+    lengths = np.array([2, 4], np.float32)
+    out = nd.sequence_mask(mx.nd.array(data), mx.nd.array(lengths),
+                           use_sequence_length=True, value=0.0)
+    expect = data.copy()
+    expect[2:, 0] = 0.0
+    assert_almost_equal(out, expect)
+
+    last = nd.sequence_last(mx.nd.array(data), mx.nd.array(lengths),
+                            use_sequence_length=True)
+    expect_last = np.stack([data[1, 0], data[3, 1]])
+    assert_almost_equal(last, expect_last)
+
+
+def test_dropout_modes():
+    x = mx.nd.ones((100, 100))
+    with mx.autograd.record(train_mode=False):
+        out = nd.Dropout(x, p=0.5)
+    assert_almost_equal(out, np.ones((100, 100)))  # identity at predict
+    with mx.autograd.record(train_mode=True):
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7  # roughly half dropped
+
+
+def test_random_ops():
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    arr = u.asnumpy()
+    assert arr.min() >= 0 and arr.max() <= 1 and 0.4 < arr.mean() < 0.6
+    n = nd.random.normal(0, 1, shape=(2000,))
+    assert abs(float(n.mean())) < 0.15
+    r = nd.random.randint(0, 5, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 5
+    # determinism under seed
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.array_equal(a, b)
+
+
+def test_cast():
+    x = mx.nd.array([1.7, 2.3])
+    assert nd.cast(x, dtype=np.int32).dtype == np.int32
+
+
+def test_gradients_simple_ops():
+    # finite-difference checks (reference check_numeric_gradient)
+    check_numeric_gradient(lambda x: (x * x).sum(), [rand_ndarray((3, 4))])
+    check_numeric_gradient(lambda x: nd.tanh(x).sum(), [rand_ndarray((3,))])
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [rand_ndarray((3, 4)), rand_ndarray((4, 2))])
+    check_numeric_gradient(
+        lambda x: nd.softmax(x).sum(axis=1).mean() + (nd.log_softmax(x)
+                                                      * 0.1).sum(),
+        [rand_ndarray((2, 5))])
+
+
+def test_conv_gradient():
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                    num_filter=2).sum(),
+        [rand_ndarray((1, 2, 4, 4)), rand_ndarray((2, 2, 3, 3))],
+        rtol=2e-2, atol=2e-2)
+
+
+def test_sdpa():
+    q = _rnd(2, 2, 4, 8)
+    out = nd.scaled_dot_product_attention(
+        mx.nd.array(q), mx.nd.array(q), mx.nd.array(q))
+    assert out.shape == (2, 2, 4, 8)
+    # causal masking keeps first position equal to its own value row
+    outc = nd.scaled_dot_product_attention(
+        mx.nd.array(q), mx.nd.array(q), mx.nd.array(q), causal=True)
+    assert_almost_equal(outc.asnumpy()[:, :, 0], q[:, :, 0], rtol=1e-4,
+                        atol=1e-5)
