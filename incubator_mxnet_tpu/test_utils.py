@@ -81,8 +81,10 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
     analytic = [x.grad.asnumpy().copy() for x in inputs]
 
     for xi, x in enumerate(inputs):
-        base = x.asnumpy().astype(np.float64)
-        numeric = np.zeros_like(base)
+        # device_get may hand back a non-C-contiguous layout; force C order so
+        # the flat views below really alias their bases
+        base = np.ascontiguousarray(x.asnumpy(), dtype=np.float64)
+        numeric = np.zeros(base.shape, np.float64)
         flat = base.reshape(-1)
         num_flat = numeric.reshape(-1)
         for j in range(flat.size):
