@@ -1,0 +1,22 @@
+"""Gluon: the imperative/hybrid high-level API (reference
+``python/mxnet/gluon/``)."""
+
+from . import loss, nn, utils
+from .block import Block, CachedOp, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+
+def __getattr__(name):
+    import importlib
+    import sys
+
+    if name in ("data", "rnn", "model_zoo", "contrib", "metric"):
+        if name == "metric":
+            from .. import metric as m
+
+            return m
+        mod = importlib.import_module("." + name, __name__)
+        setattr(sys.modules[__name__], name, mod)
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

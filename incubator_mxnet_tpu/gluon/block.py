@@ -1,0 +1,562 @@
+"""Gluon Block / HybridBlock / CachedOp.
+
+Capability parity with reference ``python/mxnet/gluon/block.py`` +
+``src/imperative/cached_op.cc`` (SURVEY.md §2.2 "Gluon core", §3.2): ``Block``
+is the eager container (child registry, parameter registry, naming scopes,
+save/load, cast, apply); ``HybridBlock.hybridize()`` converts the imperative
+forward into a cached, compiled graph invoked as a single op.
+
+TPU-native redesign of CachedOp: the reference traces ``hybrid_forward`` with
+symbols into an nnvm graph, then replays it through the engine with memory
+planning and op bulking. Here tracing and replay are both XLA's job:
+
+* forward-only (inference): ``jax.jit`` of the pure forward — XLA does fusion,
+  memory planning (``static_alloc``), and async dispatch.
+* recorded forward (training): two cached executables per input signature —
+  ``fwd``(params, inputs) -> (outputs, vjp residuals) and ``bwd``(residuals,
+  cotangents) -> input cotangents. The pair is the compiled analog of
+  CachedOp::Forward/Backward; the autograd tape stores a closure over ``bwd``
+  so ``loss.backward()`` replays one XLA executable instead of walking ops.
+
+Parameter reads inside the trace come from function arguments (so the jitted
+graph is pure); forward-time parameter writes (BatchNorm running stats) are
+captured as extra outputs and rebound after the call — the functional
+replacement for the reference's mutable aux states.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random
+from ..device import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndimpl
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
+                        _trace)
+
+
+class _BlockScope:
+    """Counter-based naming scope (reference ``_BlockScope``)."""
+
+    _tls = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+        self._old = None
+
+    @classmethod
+    def _current(cls):
+        return getattr(cls._tls, "current", None)
+
+    @classmethod
+    def create(cls, prefix, params, hint) -> Tuple[str, ParameterDict]:
+        current = cls._current()
+        if current is None:
+            if prefix is None:
+                prefix = _name_counter(hint) + "_"
+            if params is not None:
+                # sharing: adopt the shared dict's prefix so lookups hit
+                # (reference _BlockScope.create semantics)
+                return prefix, ParameterDict(params.prefix, params)
+            return prefix, ParameterDict(prefix, params)
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        parent = current._block.params
+        full_prefix = parent.prefix + prefix
+        if params is not None:
+            return full_prefix, ParameterDict(params.prefix, params)
+        return full_prefix, ParameterDict(full_prefix, parent._shared)
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old = self._current()
+        type(self)._tls.current = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        type(self)._tls.current = self._old
+
+
+_global_counters: Dict[str, int] = {}
+
+
+def _name_counter(hint: str) -> str:
+    count = _global_counters.get(hint, 0)
+    _global_counters[hint] = count + 1
+    return f"{hint}{count}"
+
+
+class Block:
+    """Base container for layers and models (reference ``gluon.Block``)."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 params: Optional[ParameterDict] = None):
+        self._empty_prefix = prefix == ""
+        hint = _camel_to_snake(type(self).__name__)
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List[Any] = []
+        self._forward_pre_hooks: List[Any] = []
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    # -- parameter collection ------------------------------------------------
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All parameters of this block and children (reference
+        ``Block.collect_params``), optionally filtered by regex."""
+        out = ParameterDict(self._params.prefix)
+        pattern = re.compile(select) if select else None
+        for name, p in self._iter_params():
+            if pattern is None or pattern.match(name):
+                out._params[name] = p
+        return out
+
+    def _iter_params(self):
+        seen = set()
+        for p in self._reg_params.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                yield p.name, p
+        for child in self._children.values():
+            for name, p in child._iter_params():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    yield name, p
+
+    def _collect_params_with_prefix(self, prefix: str = ""):
+        """Attribute-path parameter names (reference ``save_parameters``
+        naming: 'dense0.weight' style structure names)."""
+        if prefix:
+            prefix += "."
+        out: "OrderedDict[str, Parameter]" = OrderedDict()
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            out.update(child._collect_params_with_prefix(prefix + cname))
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+    def initialize(self, init=None, ctx: Optional[Context] = None,
+                   verbose: bool = False, force_reinit: bool = False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit,
+                                         verbose=verbose)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+        self._clear_cached_op()
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def hybridize(self, active: bool = True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def _clear_cached_op(self):
+        pass
+
+    # -- serialization --------------------------------------------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        """Save with structure-based names (reference
+        ``Block.save_parameters``)."""
+        params = self._collect_params_with_prefix()
+        arg = {name: p.data() for name, p in params.items()
+               if p._data is not None}
+        _ndimpl.save(filename, arg)
+
+    def load_parameters(self, filename: str, ctx=None,
+                        allow_missing: bool = False,
+                        ignore_extra: bool = False, cast_dtype: bool = False):
+        loaded = _ndimpl.load(filename, ctx=ctx)
+        params = self._collect_params_with_prefix()
+        if loaded and params and all("." not in k for k in loaded) \
+                and any("." in k for k in params):
+            # tolerate prefix-style files (collect_params().save output)
+            short = {k.split("_", 1)[-1] if "_" in k else k: v
+                     for k, v in loaded.items()}
+            loaded = short
+        for name, p in params.items():
+            if name in loaded:
+                v = loaded[name]
+                if cast_dtype:
+                    v = v.astype(p.dtype)
+                if p._data is None:
+                    if p._shape_known() and tuple(p.shape) != tuple(v.shape):
+                        raise ValueError(
+                            f"parameter {name}: declared shape {p.shape} "
+                            f"does not match saved shape {v.shape}")
+                    p.shape = v.shape
+                    p._deferred = p._deferred or ("zeros",
+                                                  ctx or current_context())
+                    p._materialize(p._deferred[0], p._deferred[1])
+                p.set_data(v)
+            elif not allow_missing:
+                raise KeyError(
+                    f"parameter {name} missing in file {filename}; "
+                    f"available: {sorted(loaded)[:8]}...")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise KeyError(f"file {filename} has extra parameters "
+                               f"{sorted(extra)[:8]}")
+
+    # legacy prefix-named save/load (reference save_params/load_params)
+    def save_params(self, filename: str):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename: str, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   restore_prefix=self.prefix)
+
+    # -- call -----------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        members = [f"\n  ({k}): {_indent(repr(v), 2)}"
+                   for k, v in self._children.items()]
+        return s + "".join(members) + ("\n)" if members else ")")
+
+
+def _indent(s, n):
+    pad = " " * n
+    lines = s.split("\n")
+    return lines[0] + "".join("\n" + pad + l for l in lines[1:])
+
+
+def _camel_to_snake(name: str) -> str:
+    return re.sub("([a-z0-9])([A-Z])", r"\1_\2",
+                  re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)).lower()
+
+
+# ---------------------------------------------------------------------------
+# CachedOp: the compiled-forward engine behind hybridize()
+# ---------------------------------------------------------------------------
+class _Trace:
+    """Active CachedOp trace: parameters resolve to tracer-backed NDArrays;
+    forward-time ``set_data`` calls become functional aux updates."""
+
+    def __init__(self, param_map: Dict[int, NDArray]):
+        self._param_map = param_map
+        self.aux: "OrderedDict[int, Tuple[Parameter, Any]]" = OrderedDict()
+
+    def param_value(self, p: Parameter) -> Optional[NDArray]:
+        return self._param_map.get(id(p))
+
+    def record_aux_update(self, p: Parameter, data) -> None:
+        val = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        self.aux[id(p)] = (p, val)
+        # later reads inside the same trace must see the updated value
+        self._param_map[id(p)] = NDArray(val)
+
+
+class CachedOp:
+    """Compiled replay of a HybridBlock forward (reference
+    ``src/imperative/cached_op.cc``). One instance per hybridized block;
+    executables cached per input signature."""
+
+    def __init__(self, block: "HybridBlock", static_alloc=False,
+                 static_shape=False, flags=()):
+        self._block = block
+        self._static_alloc = static_alloc  # XLA buffer assignment: implicit
+        self._static_shape = static_shape
+        self._fwd_cache: Dict[Any, Any] = {}
+        self._bwd_cache: Dict[Any, Any] = {}
+
+    # -- pure function over (param data..., input data..., rng) -------------
+    def _make_pure(self, params: List[Parameter], n_inputs: int,
+                   training: bool, holder: dict):
+        block = self._block
+        n_params = len(params)
+
+        def pure(*flat):
+            param_data = flat[:n_params]
+            input_data = flat[n_params:n_params + n_inputs]
+            rng = flat[-1]
+            param_map = {id(p): NDArray(d)
+                         for p, d in zip(params, param_data)}
+            trace = _Trace(param_map)
+            ins = [NDArray(d) for d in input_data]
+            _trace.stack.append(trace)
+            try:
+                with _random.key_provider(rng), \
+                        autograd._RecordingStateScope(False, training):
+                    out = block.forward(*ins)
+            finally:
+                _trace.stack.pop()
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            out_data = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
+                        for l in leaves]
+            holder["treedef"] = treedef
+            holder["aux_params"] = [p for p, _ in trace.aux.values()]
+            aux_data = [v for _, v in trace.aux.values()]
+            return tuple(out_data) + tuple(aux_data)
+
+        return pure
+
+    @staticmethod
+    def _sig(params, inputs, training, recording):
+        return (tuple((p.shape, str(p.dtype)) for p in params),
+                tuple((x.shape, str(x.dtype)) for x in inputs),
+                training, recording)
+
+    def __call__(self, *inputs: NDArray):
+        block = self._block
+        by_name = block._collect_params_with_prefix()
+        params, seen = [], set()
+        for name in sorted(by_name):
+            p = by_name[name]
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        # materialization check: deferred params force one eager call first
+        for p in params:
+            if p._data is None:
+                raise DeferredInitializationError(p.name)
+        training = autograd.is_training()
+        recording = autograd.is_recording()
+        key = self._sig(params, inputs, training, recording)
+        param_data = [p._data._data for p in params]
+        input_data = [x._data for x in inputs]
+        rng = _random.next_key()
+        args = param_data + input_data + [rng]
+
+        if not recording:
+            entry = self._fwd_cache.get(key)
+            if entry is None:
+                holder: dict = {}
+                pure = self._make_pure(params, len(inputs), training, holder)
+                jitted = jax.jit(pure)
+                entry = {"jit": jitted, "holder": holder}
+                self._fwd_cache[key] = entry
+            flat = entry["jit"](*args)
+            return self._wrap_outputs(flat, entry["holder"], inputs)
+
+        # recording: cached fwd(returning vjp residuals) + bwd executables
+        entry = self._bwd_cache.get(key)
+        if entry is None:
+            holder = {}
+            pure = self._make_pure(params, len(inputs), training, holder)
+            # trace once eagerly to learn the vjp residual structure
+            out_flat, vjp_fn = jax.vjp(pure, *args)
+            res_leaves, vjp_treedef = jax.tree_util.tree_flatten(vjp_fn)
+
+            def fwd_split(*a):
+                o, v = jax.vjp(pure, *a)
+                return o, jax.tree_util.tree_flatten(v)[0]
+
+            def bwd(res_flat, cts):
+                f = jax.tree_util.tree_unflatten(vjp_treedef, res_flat)
+                return f(cts)
+
+            entry = {"fwd": jax.jit(fwd_split), "bwd": jax.jit(bwd),
+                     "holder": holder, "pure": pure}
+            self._bwd_cache[key] = entry
+            res_flat = res_leaves
+        else:
+            out_flat, res_flat = entry["fwd"](*args)
+
+        holder = entry["holder"]
+        out, all_nds = self._wrap_outputs(out_flat, holder, inputs,
+                                          return_all=True)
+
+        bwd_exec = entry["bwd"]
+
+        def vjp_closure(cts):
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            return bwd_exec(list(res_flat), tuple(cts))
+
+        tape_inputs = [p._data for p in params] + list(inputs)
+        autograd.record_op(vjp_closure, tape_inputs, all_nds,
+                           name=f"CachedOp({block.name})",
+                           pure_fn=entry["pure"])
+        return out
+
+    def _wrap_outputs(self, flat, holder, inputs, return_all=False):
+        treedef = holder["treedef"]
+        aux_params = holder.get("aux_params", [])
+        n_out = treedef.num_leaves
+        ctx = inputs[0].ctx if inputs else current_context()
+        out_nds = [NDArray(d, ctx=ctx) for d in flat[:n_out]]
+        aux_vals = flat[n_out:n_out + len(aux_params)]
+        aux_nds = []
+        out = jax.tree_util.tree_unflatten(treedef, out_nds)
+        # rebind aux states (running stats) after the compiled call
+        for p, v in zip(aux_params, aux_vals):
+            aux_nds.append(NDArray(v, ctx=ctx))
+            if p._data is None:
+                p.set_data(NDArray(v))
+            else:
+                p._data._set_data(v)
+        if return_all:
+            return out, out_nds + aux_nds
+        return out
+
+
+class HybridBlock(Block):
+    """Block convertible to a compiled graph (reference ``HybridBlock``).
+
+    Users implement ``hybrid_forward(self, F, x, *args, **params)`` where
+    ``F`` is the op namespace and registered parameters arrive as keyword
+    NDArrays. ``hybridize()`` routes calls through a CachedOp.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op: Optional[CachedOp] = None
+        self._cached_op_args: dict = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs):
+        self._active = active
+        self._cached_op = None
+        self._cached_op_args = dict(static_alloc=static_alloc,
+                                    static_shape=static_shape, **kwargs)
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def cast(self, dtype):
+        super().cast(dtype)
+        self._clear_cached_op()
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from input shapes. Leaf layers
+        override; containers resolve through their children's forwards."""
+        raise DeferredInitializationError(
+            f"{type(self).__name__} cannot infer parameter shapes; "
+            "pass explicit in_units/in_channels or run one eager forward")
+
+    def _resolve_params(self, *args) -> Dict[str, Optional[NDArray]]:
+        try:
+            return {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                if p._data is None:
+                    p._finish_deferred_init(p.shape)
+            return {k: p.data() for k, p in self._reg_params.items()}
+
+    def __call__(self, *args):
+        if self._active and self._cached_op is None:
+            self._cached_op = CachedOp(self, **self._cached_op_args)
+        if (self._active and _trace.stack == []
+                and all(isinstance(a, NDArray) for a in args)):
+            try:
+                for hook in self._forward_pre_hooks:
+                    hook(self, args)
+                out = self._cached_op(*args)
+                for hook in self._forward_hooks:
+                    hook(self, args, out)
+                return out
+            except DeferredInitializationError:
+                # first call resolves deferred shapes eagerly, then compiles
+                pass
+        return super().__call__(*args)
+
+    def forward(self, x, *args):
+        from .. import ndarray as F
+
+        params = self._resolve_params(x, *args)
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path: str, epoch: int = 0):
+        """Serialize for deployment (reference ``HybridBlock.export``:
+        symbol-json + params). Here: params + a StableHLO text of the jitted
+        forward when available."""
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        return f"{path}-{epoch:04d}.params"
+
+
+class SymbolBlock(HybridBlock):
+    """Placeholder for graph-import blocks (reference ``SymbolBlock``);
+    arrives with the symbol module."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        raise NotImplementedError(
+            "SymbolBlock arrives with the symbol/module shim")

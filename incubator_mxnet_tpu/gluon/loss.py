@@ -1,0 +1,365 @@
+"""Gluon losses.
+
+Capability parity with reference ``python/mxnet/gluon/loss.py``: Loss base
+(weight / sample_weight / batch_axis semantics), L1/L2, SoftmaxCE, sigmoid
+BCE, KL, CTC (via optax's XLA-native lattice implementation), Huber, Hinge,
+SquaredHinge, Logistic, Triplet, Cosine, PoissonNLL.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, invoke, as_nd
+from .block import HybridBlock
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None and weight != 1.0:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    return jnp.reshape(label, pred.shape)
+
+
+class Loss(HybridBlock):
+    """Base loss (reference ``gluon.loss.Loss``): returns one scalar per
+    sample along ``batch_axis`` (mean over the other axes)."""
+
+    def __init__(self, weight=1.0, batch_axis=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_per_sample(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return jnp.mean(loss, axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            loss = jnp.square(p - _reshape_like(p, l)) / 2
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="l2_loss")
+
+
+class L1Loss(Loss):
+    def forward(self, pred, label, sample_weight=None):
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            loss = jnp.abs(p - _reshape_like(p, l))
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="l1_loss")
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax CE (reference ``SoftmaxCrossEntropyLoss``): fused
+    log-softmax + gather; runs in fp32 regardless of input dtype for
+    numerical safety (MXNET_SAFE_ACCUMULATION analog)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        axis, sparse, from_logits = self._axis, self._sparse, self._from_logits
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            logp = p.astype(jnp.float32) if from_logits \
+                else jax.nn.log_softmax(p.astype(jnp.float32), axis=axis)
+            if sparse:
+                li = jnp.expand_dims(l.astype(jnp.int32), axis)
+                loss = -jnp.take_along_axis(logp, li, axis=axis)
+                loss = jnp.squeeze(loss, axis)
+            else:
+                loss = -jnp.sum(logp * l.astype(jnp.float32), axis=axis)
+            return f(_apply_weighting(loss, w, sw)).astype(p.dtype)
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="softmax_ce_loss")
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        from_sigmoid = self._from_sigmoid
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            l = _reshape_like(p, l)
+            if not from_sigmoid:
+                # log(1+exp(x)) stable form
+                loss = jax.nn.relu(p) - p * l + jax.nn.softplus(-jnp.abs(p))
+            else:
+                eps = 1e-12
+                loss = -(jnp.log(p + eps) * l
+                         + jnp.log(1 - p + eps) * (1 - l))
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="sigmoid_bce_loss")
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        from_logits, axis = self._from_logits, self._axis
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            if not from_logits:
+                p = jax.nn.log_softmax(p, axis=axis)
+            loss = l * (jnp.log(l + 1e-12) - p)
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="kldiv_loss")
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        rho = self._rho
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            d = jnp.abs(p - _reshape_like(p, l))
+            loss = jnp.where(d > rho, d - 0.5 * rho, 0.5 / rho * d * d)
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="huber_loss")
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1.0, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        margin = self._margin
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            loss = jax.nn.relu(margin - p * _reshape_like(p, l))
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="hinge_loss")
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1.0, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        margin = self._margin
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            loss = jnp.square(jax.nn.relu(margin - p * _reshape_like(p, l)))
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="squared_hinge_loss")
+
+
+class LogisticLoss(Loss):
+    def __init__(self, label_format="signed", weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._fmt = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        fmt = self._fmt
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(p, l, sw=None):
+            l = _reshape_like(p, l)
+            if fmt == "signed":
+                l = (l + 1.0) / 2.0
+            loss = jax.nn.relu(p) - p * l + jax.nn.softplus(-jnp.abs(p))
+            return f(_apply_weighting(loss, w, sw))
+
+        args = [pred, as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="logistic_loss")
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1.0, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        margin = self._margin
+        w, f = self._weight, self._mean_per_sample
+
+        def fn(a, p, n, sw=None):
+            axes = tuple(range(1, a.ndim))
+            loss = jax.nn.relu(
+                jnp.sum(jnp.square(a - p) - jnp.square(a - n), axis=axes)
+                + margin)
+            return _apply_weighting(loss, w, sw)
+
+        args = [pred, as_nd(positive), as_nd(negative)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="triplet_loss")
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, margin=0.0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        margin = self._margin
+        w = self._weight
+
+        def fn(x1, x2, l, sw=None):
+            x1f = jnp.reshape(x1, (x1.shape[0], -1))
+            x2f = jnp.reshape(x2, (x2.shape[0], -1))
+            cos = jnp.sum(x1f * x2f, axis=-1) / (
+                jnp.linalg.norm(x1f, axis=-1)
+                * jnp.linalg.norm(x2f, axis=-1) + 1e-12)
+            l = jnp.reshape(l, cos.shape)
+            loss = jnp.where(l > 0, 1.0 - cos, jax.nn.relu(cos - margin))
+            return _apply_weighting(loss, w, sw)
+
+        args = [input1, as_nd(input2), as_nd(label)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="cosine_embedding_loss")
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, from_logits=True, compute_full=False, weight=1.0,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        from_logits, full = self._from_logits, self._full
+        w = self._weight
+
+        def fn(p, t, sw=None):
+            t = _reshape_like(p, t)
+            if from_logits:
+                loss = jnp.exp(p) - t * p
+            else:
+                loss = p - t * jnp.log(p + epsilon)
+            if full:
+                loss = loss + (t * jnp.log(t + 1e-12) - t
+                               + 0.5 * jnp.log(2 * jnp.pi * (t + 1e-12)))
+            return jnp.mean(_apply_weighting(loss, w, sw),
+                            axis=tuple(range(1, loss.ndim)))
+
+        args = [pred, as_nd(target)] + (
+            [as_nd(sample_weight)] if sample_weight is not None else [])
+        return invoke(fn, args, name="poisson_nll_loss")
+
+
+class CTCLoss(Loss):
+    """CTC loss (reference ``gluon.loss.CTCLoss`` over warp-ctc/cuDNN).
+
+    TPU-native: optax's pure-XLA CTC lattice. Layouts follow the reference:
+    ``layout`` 'NTC'/'TNC' for pred, blank label id 0... reference uses
+    blank=0 with 'TNC' default.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        super().__init__(weight or 1.0, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None):
+        import optax
+
+        layout = self._layout
+        w = self._weight
+
+        def fn(p, l, pl=None, ll=None):
+            if layout == "TNC":
+                p = jnp.transpose(p, (1, 0, 2))
+            b, t, _ = p.shape
+            lpad = jnp.where(l < 0, 0, l).astype(jnp.int32)
+            if pl is None:
+                logitpad = jnp.zeros((b, t), p.dtype)
+            else:
+                pos = jnp.arange(t)[None, :]
+                logitpad = (pos >= pl[:, None]).astype(p.dtype)
+            if ll is None:
+                labelpad = (l < 0).astype(p.dtype)
+            else:
+                pos = jnp.arange(l.shape[1])[None, :]
+                labelpad = (pos >= ll[:, None]).astype(p.dtype)
+            # optax blank_id default 0 matches the reference's blank=0
+            loss = optax.ctc_loss(p.astype(jnp.float32), logitpad, lpad,
+                                  labelpad)
+            return loss * w if w != 1.0 else loss
+
+        # pred/label lengths are each independently optional
+        args = [pred, as_nd(label)]
+        has_pl = pred_lengths is not None
+        has_ll = label_lengths is not None
+        if has_pl:
+            args.append(as_nd(pred_lengths))
+        if has_ll:
+            args.append(as_nd(label_lengths))
+
+        def dispatch(*arrs):
+            p, l = arrs[0], arrs[1]
+            rest = list(arrs[2:])
+            pl = rest.pop(0) if has_pl else None
+            ll = rest.pop(0) if has_ll else None
+            return fn(p, l, pl, ll)
+
+        return invoke(dispatch, args, name="ctc_loss")

@@ -1,0 +1,76 @@
+"""Activation blocks (reference ``python/mxnet/gluon/nn/activations.py``)."""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer="zeros", in_channels=1, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.alpha = self.params.get("alpha", shape=(in_channels,),
+                                         init=alpha_initializer)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.LeakyReLU(x, params["alpha"], act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximate=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._approx = approximate
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.gelu(x, approximate=self._approx)
+
+
+class SiLU(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.silu(x)
+
+
+Swish = SiLU

@@ -1,0 +1,365 @@
+"""Basic Gluon layers.
+
+Capability parity with reference ``python/mxnet/gluon/nn/basic_layers.py``:
+Dense, Dropout, BatchNorm, LayerNorm/GroupNorm/InstanceNorm, Embedding,
+Flatten, Activation, Lambda, Sequential/HybridSequential. Kernels are jax
+functions from the op registry, lowered by XLA onto the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import autograd
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+
+class Sequential(Block):
+    """Stack of blocks run eagerly (reference ``nn.Sequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+            # also expose as attribute for _collect_params_with_prefix paths
+            setattr(self, str(len(self._children) - 1), b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    """Stack of hybridizable blocks (reference ``nn.HybridSequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+            setattr(self, str(len(self._children) - 1), b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference ``nn.Dense`` over FullyConnected;
+    weight layout (units, in_units))."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        self._act = activation
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            else:
+                self.bias = None
+        if self.bias is None:
+            self._reg_params.pop("bias", None)
+
+    def infer_shape(self, x, *args):
+        in_units = int(np.prod(x.shape[1:])) if self._flatten \
+            else int(x.shape[-1])
+        self.weight.shape = (self._units, in_units)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        w = params["weight"]
+        b = params.get("bias")
+        out = F.FullyConnected(x, w, b, num_hidden=self._units,
+                               flatten=self._flatten)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference ``nn.Dropout``); active only in train mode."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._act = activation
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.Activation(x, act_type=self._act)
+
+
+class Flatten(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x, *args):
+        return x.flatten()
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (reference ``nn.Lambda``)."""
+
+    def __init__(self, function, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(function, str):
+            from ... import ndarray as F
+
+            function = getattr(F, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(function, str):
+            from ... import ndarray as F
+
+            function = getattr(F, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Embedding(HybridBlock):
+    """Index → vector lookup (reference ``nn.Embedding``); gathers ride the
+    TPU's native dynamic-slice path."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.Embedding(x, params["weight"], input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with running stats (reference ``nn.BatchNorm``).
+
+    Running means/vars are non-differentiable parameters updated functionally:
+    in a hybridized forward the update is captured as an extra graph output
+    and rebound after the compiled call (see CachedOp), replacing the
+    reference kernel's in-place aux-state writes.
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=shape, init=gamma_initializer,
+                grad_req="write" if scale else "null")
+            self.beta = self.params.get(
+                "beta", shape=shape, init=beta_initializer,
+                grad_req="write" if center else "null")
+            self.running_mean = self.params.get(
+                "running_mean", shape=shape,
+                init=running_mean_initializer, grad_req="null")
+            self.running_var = self.params.get(
+                "running_var", shape=shape,
+                init=running_variance_initializer, grad_req="null")
+
+    def infer_shape(self, x, *args):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        training = autograd.is_training() and not self._use_global_stats
+        out = F.BatchNorm(x, params["gamma"], params["beta"],
+                          params["running_mean"], params["running_var"],
+                          eps=self._eps, momentum=self._momentum,
+                          fix_gamma=not self._scale, axis=self._axis,
+                          use_global_stats=self._use_global_stats,
+                          training=training)
+        if training:
+            out, mean, var = out
+            m = self._momentum
+            self.running_mean.set_data(
+                params["running_mean"] * m + mean.detach() * (1 - m))
+            self.running_var.set_data(
+                params["running_var"] * m + var.detach() * (1 - m))
+        return out
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (reference ``nn.LayerNorm``)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=shape, init=gamma_initializer,
+                grad_req="write" if scale else "null")
+            self.beta = self.params.get(
+                "beta", shape=shape, init=beta_initializer,
+                grad_req="write" if center else "null")
+
+    def infer_shape(self, x, *args):
+        c = int(x.shape[self._axis])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.LayerNorm(x, params["gamma"], params["beta"],
+                           axis=self._axis, eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=shape,
+                                         init=gamma_initializer)
+            self.beta = self.params.get("beta", shape=shape,
+                                        init=beta_initializer)
+
+    def infer_shape(self, x, *args):
+        c = int(x.shape[1])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.GroupNorm(x, params["gamma"], params["beta"],
+                           num_groups=self._num_groups, eps=self._eps)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=shape,
+                                         init=gamma_initializer)
+            self.beta = self.params.get("beta", shape=shape,
+                                        init=beta_initializer)
+
+    def infer_shape(self, x, *args):
+        c = int(x.shape[1])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.InstanceNorm(x, params["gamma"], params["beta"],
+                              eps=self._eps)
+
+
+class RMSNorm(HybridBlock):
+    """RMS normalization (TPU-era addition for transformer stacks)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=shape,
+                                         init=gamma_initializer)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (int(x.shape[self._axis]),)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        return F.rms_norm(x, params["gamma"], axis=self._axis, eps=self._eps)

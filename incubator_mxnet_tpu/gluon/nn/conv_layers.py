@@ -1,0 +1,260 @@
+"""Convolution and pooling layers.
+
+Capability parity with reference ``python/mxnet/gluon/nn/conv_layers.py``
+(Conv1D/2D/3D, Conv*Transpose, Max/Avg/Global pooling, padding layers).
+Layout is NC+spatial like the reference; XLA's layout assignment retiles for
+the MXU internally, so no im2col/algo-selection machinery exists here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops.nn import _ntuple
+from ..block import HybridBlock
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", ndim=2, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _ntuple(kernel_size, ndim)
+        self._strides = _ntuple(strides, ndim)
+        self._padding = _ntuple(padding, ndim)
+        self._dilation = _ntuple(dilation, ndim)
+        self._groups = groups
+        self._act = activation
+        self._ndim = ndim
+        wshape = (channels, in_channels // groups if in_channels else 0) \
+            + self._kernel
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x, *args):
+        in_c = int(x.shape[1])
+        self._in_channels = in_c
+        self.weight.shape = (self._channels, in_c // self._groups) \
+            + self._kernel
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        out = F.Convolution(x, params["weight"], params.get("bias"),
+                            kernel=self._kernel, stride=self._strides,
+                            pad=self._padding, dilate=self._dilation,
+                            num_filter=self._channels,
+                            num_group=self._groups)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=3, **kwargs)
+
+
+class _ConvTranspose(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, output_padding,
+                 dilation, groups, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", ndim=2, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._channels = channels
+        self._kernel = _ntuple(kernel_size, ndim)
+        self._strides = _ntuple(strides, ndim)
+        self._padding = _ntuple(padding, ndim)
+        self._out_padding = _ntuple(output_padding, ndim)
+        self._dilation = _ntuple(dilation, ndim)
+        self._groups = groups
+        self._act = activation
+        self._ndim = ndim
+        # reference deconvolution weight layout: (in, out/g, *k)
+        wshape = (in_channels if in_channels else 0, channels // groups) \
+            + self._kernel
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x, *args):
+        in_c = int(x.shape[1])
+        self.weight.shape = (in_c, self._channels // self._groups) \
+            + self._kernel
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        out = F.Deconvolution(x, params["weight"], params.get("bias"),
+                              kernel=self._kernel, stride=self._strides,
+                              pad=self._padding, adj=self._out_padding,
+                              dilate=self._dilation,
+                              num_filter=self._channels,
+                              num_group=self._groups)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding,
+                         output_padding, dilation, groups, ndim=1, **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding,
+                         output_padding, dilation, groups, ndim=2, **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, ndim, count_include_pad=True, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._kernel = _ntuple(pool_size, ndim)
+        self._strides = _ntuple(strides if strides is not None else pool_size,
+                                ndim)
+        self._padding = _ntuple(padding, ndim)
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        return F.Pooling(x, kernel=self._kernel, pool_type=self._type,
+                         stride=self._strides, pad=self._padding,
+                         global_pool=self._global,
+                         count_include_pad=self._count_include_pad,
+                         pooling_convention="full" if self._ceil else "valid")
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "max", 1, **kwargs)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "max", 2, **kwargs)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "max", 3, **kwargs)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "avg", 1, count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "avg", 2, count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode, False,
+                         "avg", 3, count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 1, **kwargs)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 2, **kwargs)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "max", 3, **kwargs)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 1, **kwargs)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 2, **kwargs)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, **kwargs):
+        super().__init__(1, None, 0, False, True, "avg", 3, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._padding = _ntuple(padding, 2)
+
+    def forward(self, x, *args):
+        from ...ndarray import invoke
+        import jax.numpy as jnp
+
+        ph, pw = self._padding
+        return invoke(
+            lambda a: jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                              mode="reflect"),
+            [x], name="reflection_pad2d")

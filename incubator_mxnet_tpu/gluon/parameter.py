@@ -168,6 +168,12 @@ class Parameter:
             self._data._grad._data = jnp.zeros_like(self._data._grad._data)
 
     def set_data(self, data) -> None:
+        new_shape = tuple(getattr(data, "shape", ()) or ())
+        if self._shape_known() and new_shape and self.shape != new_shape:
+            raise ValueError(
+                f"cannot set data of parameter {self.name}: expected shape "
+                f"{self.shape}, got {new_shape} (reference Parameter.set_data "
+                "shape check)")
         tr = current_trace()
         if tr is not None:
             tr.record_aux_update(self, data)

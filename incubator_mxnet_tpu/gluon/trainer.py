@@ -1,0 +1,150 @@
+"""Gluon Trainer.
+
+Capability parity with reference ``python/mxnet/gluon/trainer.py``
+(SURVEY.md §2.2 "Gluon core", §3.3): kvstore setup + ``update_on_kvstore``
+decision, ``step`` (rescale → allreduce → optimizer update), ``allreduce_grads``
+/ ``update`` split for gradient accumulation, learning-rate plumbing, and
+optimizer-state save/load.
+
+TPU-native redesign: a Parameter is one logical array, so the reference's
+cross-copy reduction disappears; what remains is (a) cross-process allreduce
+via the kvstore facade when running multi-host, and (b) per-parameter
+jit-fused update kernels (see optimizer module). Comm/compute overlap comes
+from XLA async collectives when the step runs inside ``parallel`` sharded
+training instead of from engine scheduling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import optimizer as opt_mod
+from ..kvstore import KVStore
+from ..kvstore import create as kv_create
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a (Parameter)Dict or list")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[int, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError(f"element {i} is not a Parameter")
+            self._param2idx[id(p)] = i
+            self._params.append(p)
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._updater = opt_mod.get_updater(self._optimizer)
+        self._kvstore: Optional[KVStore] = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore_spec = kvstore
+        self._distributed = False
+
+    # -- kvstore ------------------------------------------------------------
+    def _init_kvstore(self):
+        spec = self._kvstore_spec
+        if spec is None or spec is False:
+            self._kvstore = None
+        elif isinstance(spec, KVStore):
+            self._kvstore = spec
+        else:
+            self._kvstore = kv_create(spec)
+        self._distributed = (self._kvstore is not None
+                             and self._kvstore.num_workers > 1)
+        if self._update_on_kvstore is None:
+            self._update_on_kvstore = False  # single-copy params: local update
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p._data is not None and p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.learning_rate = lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # -- stepping -----------------------------------------------------------
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """Rescale by 1/batch_size, allreduce (if distributed), update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None or not self._distributed:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None \
+                    and p._data._grad is not None:
+                g = p.grad()
+                self._kvstore.pushpull(i, g, out=g)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._data._grad is None:
+                continue
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, out=p.data())
+            else:
+                self._updater(i, p.grad(), p.data())
+
+    # -- states -------------------------------------------------------------
+    def save_states(self, fname: str):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_states(self, fname: str):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

@@ -1,0 +1,94 @@
+"""Gluon utilities.
+
+Capability parity with reference ``python/mxnet/gluon/utils.py``:
+``split_data``/``split_and_load`` (data-parallel batch slicing),
+``clip_global_norm``, ``check_sha1``, ``download`` (gated: no network in this
+environment).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List
+
+import numpy as np
+
+from ..device import Context
+from ..ndarray import NDArray, as_nd, invoke
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Slice one batch into ``num_slice`` parts (reference ``split_data``)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"batch size {size} not divisible by num_slice {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Slice a batch across contexts (reference ``split_and_load``).
+
+    On the SPMD path a sharded global array supersedes this; the per-context
+    list form is kept for reference-script compatibility.
+    """
+    data = as_nd(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True):
+    """Rescale arrays so the joint L2 norm is <= max_norm (reference
+    ``clip_global_norm``). Mutates in place, returns the norm."""
+    import jax.numpy as jnp
+
+    total = sum(float((a * a).sum().asscalar()) for a in arrays)
+    norm = float(np.sqrt(total))
+    if check_isfinite and not np.isfinite(norm):
+        import warnings
+
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True) -> str:
+    """Reference ``gluon.utils.download``. This environment has no network
+    egress; only already-downloaded files resolve."""
+    fname = url.split("/")[-1] if path is None or os.path.isdir(path or ".") \
+        else path
+    if path and os.path.isdir(path):
+        fname = os.path.join(path, fname)
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"download({url!r}): no network egress in this environment; place "
+        f"the file at {fname!r} manually")

@@ -1,0 +1,347 @@
+"""Data iterators (reference ``python/mxnet/io/``).
+
+Capability parity: ``DataIter`` protocol (``next/iter_next/getdata/getlabel/
+provide_data/provide_label/reset``), ``DataBatch``/``DataDesc``,
+``NDArrayIter`` (incl. shuffle, last-batch handling, data/label dicts),
+``ResizeIter``, ``PrefetchingIter``, ``CSVIter``.
+
+TPU-native notes: host-side batching feeds ``jax.device_put`` directly; the
+heavy C++ RecordIO/JPEG path of the reference lives in the separate recordio/
+image modules (SURVEY.md §2.1 "C++ data pipeline").
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..ndarray import NDArray, array as nd_array
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (np.float32, "NCHW")
+
+
+class DataBatch:
+    """One minibatch (reference ``mx.io.DataBatch``)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        lshapes = [getattr(l, "shape", None) for l in (self.label or [])]
+        return f"DataBatch: data shapes: {shapes} label shapes: {lshapes}"
+
+
+class DataIter:
+    """Iterator protocol (reference ``mx.io.DataIter``)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data into an ordered list of (name, np.ndarray)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data required")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = OrderedDict([(default_name, data[0])])
+        else:
+            data = OrderedDict(
+                [(f"_{i}_{default_name}", d) for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError(f"bad data type {type(data)}")
+    out = []
+    for k, v in data.items():
+        v = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference ``mx.io.NDArrayIter``): shuffle,
+    last_batch_handle 'pad'/'discard'/'roll_over', dict inputs."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cache_idx = None
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and not self.shuffle \
+                and 0 < self.cursor < self.num_data:
+            # leftover (un-emitted) samples lead the next epoch
+            leftover = self.num_data - self.cursor
+            self.data = [(k, np.roll(v, leftover, axis=0))
+                         for k, v in self.data]
+            self.label = [(k, np.roll(v, leftover, axis=0))
+                          for k, v in self.label]
+        if self.shuffle:
+            idx = np.random.permutation(self.num_data)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+        self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        self.cursor += self.batch_size
+        if self.last_batch_handle in ("discard", "roll_over"):
+            # roll_over defers the final partial batch: reset() offsets the
+            # next epoch's cursor so the leftover samples lead it (reference
+            # semantics), rather than emitting a wrap-padded batch now.
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _getdata(self, source):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            return [nd_array(v[self.cursor:end]) for _, v in source]
+        # pad by wrapping around (reference 'pad' semantics)
+        out = []
+        for _, v in source:
+            first = v[self.cursor:]
+            pad = v[:end - self.num_data]
+            out.append(nd_array(np.concatenate([first, pad], axis=0)))
+        return out
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self) -> int:
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference
+    ``mx.io.ResizeIter``)."""
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch: Optional[DataBatch] = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self) -> bool:
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def getindex(self):
+        return self.current_batch.index
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference ``mx.io.PrefetchingIter`` over
+    dmlc ThreadedIter). PJRT transfers are async already; this hides host
+    numpy work."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        iters = iters if isinstance(iters, list) else [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._batch: Optional[List[DataBatch]] = None
+        self._data_ready = threading.Event()
+        self._data_taken = threading.Event()
+        self._data_taken.set()
+        self._started = True
+        self.current_batch: Optional[DataBatch] = None
+
+        def prefetch(self_=self):
+            while self_._started:
+                self_._data_taken.wait()
+                if not self_._started:
+                    break
+                try:
+                    self_._batch = [i.next() for i in self_.iters]
+                except StopIteration:
+                    self_._batch = None
+                self_._data_taken.clear()
+                self_._data_ready.set()
+
+        self._thread = threading.Thread(target=prefetch, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def reset(self):
+        self._data_ready.wait()
+        for i in self.iters:
+            i.reset()
+        self._data_ready.clear()
+        self._data_taken.set()
+
+    def iter_next(self) -> bool:
+        self._data_ready.wait()
+        if self._batch is None:
+            return False
+        self.current_batch = self._batch[0] if len(self._batch) == 1 else \
+            DataBatch(sum([b.data for b in self._batch], []),
+                      sum([(b.label or []) for b in self._batch], []))
+        self._data_ready.clear()
+        self._data_taken.set()
+        return True
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def __del__(self):
+        self._started = False
+        self._data_taken.set()
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference ``src/io/iter_csv.cc``)."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", ndmin=2, dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", ndmin=2,
+                               dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad" if round_batch
+                                  else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()

@@ -1,0 +1,201 @@
+"""KVStore facade.
+
+Capability parity with reference ``python/mxnet/kvstore.py`` over
+``src/kvstore/*`` (SURVEY.md §2.1 KVStore rows): ``create('local' | 'device'
+| 'nccl' | 'dist_sync' | 'dist_async' | 'p3')``, ``init/push/pull/pushpull``,
+``set_optimizer`` (update-on-kvstore), rank/num_workers, optimizer-state
+save/load.
+
+TPU-native redesign: the reference aggregates gradients across per-device
+copies (CPU reduce, GPU P2P trees, NCCL rings) or across processes
+(ps-lite/ZMQ parameter server). Here a parameter is ONE logical jax array —
+replicated or sharded over a Mesh — so intra-process aggregation is either a
+trivial list-sum (per-ctx API compatibility) or already folded into the
+jitted step as an XLA AllReduce over ICI (see ``parallel``). Cross-host
+('dist_*') maps onto ``jax.distributed`` + global meshes; PS-style 'dist_async'
+has no XLA analog and is emulated synchronously (documented divergence,
+SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+_KV_TYPES = ("local", "device", "nccl", "horovod", "dist_sync", "dist_async",
+             "dist_device_sync", "p3")
+
+
+def create(name: str = "local") -> "KVStore":
+    """Create a kvstore (reference ``mx.kv.create``)."""
+    if name not in _KV_TYPES:
+        raise ValueError(f"unknown kvstore type {name!r}; known {_KV_TYPES}")
+    if name.startswith("dist"):
+        return KVStoreDist(name)
+    return KVStore(name)
+
+
+class KVStore:
+    """Single-process store: 'local' reduce == list-sum; 'device'/'nccl'
+    reduce == the same sum, which XLA lowers to an ICI AllReduce when the
+    operands are sharded over a mesh."""
+
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- core ops ----------------------------------------------------------
+    @staticmethod
+    def _key_list(key):
+        single = not isinstance(key, (list, tuple))
+        return ([key], single) if single else (list(key), False)
+
+    @staticmethod
+    def _val_list(value, n):
+        if isinstance(value, NDArray):
+            if n != 1:
+                raise ValueError(
+                    f"got a single NDArray for {n} keys; pass one value "
+                    "(or per-device value list) per key")
+            return [[value]]
+        if isinstance(value, (list, tuple)):
+            if n == 1 and all(isinstance(v, NDArray) for v in value):
+                return [list(value)]
+            if len(value) != n:
+                raise ValueError(
+                    f"value list length {len(value)} != number of keys {n}")
+            return [v if isinstance(v, (list, tuple)) else [v]
+                    for v in value]
+        raise TypeError(f"bad value type {type(value)}")
+
+    def init(self, key, value) -> None:
+        keys, _ = self._key_list(key)
+        vals = self._val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                continue
+            v = vlist[0] if isinstance(vlist, (list, tuple)) else vlist
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._store[k] = NDArray(v._data, ctx=v.ctx)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, _ = self._key_list(key)
+        vals = self._val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            agg = self._reduce(vlist)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[k]._set_data(agg._data)
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        keys, _ = self._key_list(key)
+        outs = self._val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o in (olist if isinstance(olist, (list, tuple)) else [olist]):
+                o._set_data(jnp.asarray(src._data, o.dtype))
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        """Fused allreduce (reference ``MXKVStorePushPullEx``): sum the
+        pushed values and write the result to ``out`` (grads in, summed
+        grads out — no optimizer involved)."""
+        keys, _ = self._key_list(key)
+        vals = self._val_list(value, len(keys))
+        if out is None:
+            self.push(key, value, priority)
+            return
+        outs = self._val_list(out, len(keys))
+        for k, vlist, olist in zip(keys, vals, outs):
+            agg = self._reduce(vlist)
+            for o in (olist if isinstance(olist, (list, tuple)) else [olist]):
+                o._set_data(jnp.asarray(agg._data, o.dtype))
+
+    def broadcast(self, key, value, out, priority: int = 0) -> None:
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def _reduce(self, vlist: List[NDArray]) -> NDArray:
+        if not isinstance(vlist, (list, tuple)):
+            return vlist
+        if len(vlist) == 1:
+            return vlist[0]
+        acc = vlist[0]._data
+        for v in vlist[1:]:
+            acc = acc + v._data
+        return NDArray(acc, ctx=vlist[0].ctx)
+
+    # -- optimizer-on-kvstore ----------------------------------------------
+    def set_updater(self, updater: Callable) -> None:
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer) -> None:
+        from . import optimizer as opt_mod
+
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
+        if self._updater is None:
+            raise RuntimeError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise RuntimeError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+class KVStoreDist(KVStore):
+    """Multi-host store over jax.distributed (reference dist_sync/dist_async
+    over ps-lite). Gradients allreduce across processes through a global
+    mesh; 'dist_async' degrades to synchronous (no XLA analog)."""
+
+    def __init__(self, kv_type: str):
+        super().__init__(kv_type)
+        self._rank = 0
+        self._size = 1
+        try:
+            self._rank = jax.process_index()
+            self._size = jax.process_count()
+        except Exception:
+            pass
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._size
+
+    def _reduce(self, vlist):
+        local = super()._reduce(vlist)
+        if self._size > 1:
+            from .parallel import allreduce_across_processes
+
+            return NDArray(allreduce_across_processes(local._data),
+                           ctx=local.ctx)
+        return local

@@ -1,0 +1,383 @@
+"""Evaluation metrics.
+
+Capability parity with reference ``python/mxnet/metric.py`` (2.x
+``gluon/metric.py``): EvalMetric base + registry (``metric.create``),
+Accuracy, TopKAccuracy, F1, MCC, MAE/MSE/RMSE, CrossEntropy, NLL, Perplexity,
+PearsonCorrelation, CompositeEvalMetric, CustomMetric / ``np`` wrapper.
+
+Metric state accumulates in Python floats after a device sync — matching the
+reference, whose metric update is the WaitToRead sync point of the train loop
+(SURVEY.md §3.4). Cross-replica metrics on a mesh psum before the sync.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import numpy as _numpy  # kept distinct: module-level `np()` api shadows np
+
+from .ndarray import NDArray
+
+_METRICS: Dict[str, type] = {}
+
+
+def register(cls):
+    _METRICS[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(metric, *args, **kwargs) -> "EvalMetric":
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    name = metric.lower()
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "top_k_accuracy": "topkaccuracy", "pearsonr":
+               "pearsoncorrelation", "nll_loss": "negativeloglikelihood"}
+    name = aliases.get(name, name)
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric {metric!r}")
+    return _METRICS[name](*args, **kwargs)
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _numpy.asarray(x)
+
+
+def _align_label(l, p):
+    """Reshape label for broadcasting against pred (reference regression
+    metrics reshape 1-D labels to column vectors)."""
+    if l.shape == p.shape:
+        return l
+    if l.size == p.size:
+        return l.reshape(p.shape)
+    return l.reshape((len(p), -1))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    @staticmethod
+    def _as_lists(labels, preds):
+        if isinstance(labels, (NDArray, _numpy.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _numpy.ndarray)):
+            preds = [preds]
+        if len(labels) != len(preds):
+            raise ValueError(
+                f"labels ({len(labels)}) and preds ({len(preds)}) differ")
+        return labels, preds
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            p = _to_np(p)
+            l = _to_np(l)
+            if p.ndim > l.ndim:
+                p = _numpy.argmax(p, axis=self.axis)
+            p = p.astype(_numpy.int64).ravel()
+            l = l.astype(_numpy.int64).ravel()
+            self.sum_metric += float((p == l).sum())
+            self.num_inst += len(l)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            p = _to_np(p)
+            l = _to_np(l).astype(_numpy.int64).ravel()
+            topk = _numpy.argsort(-p, axis=-1)[..., :self.top_k].reshape(
+                len(l), -1)
+            self.sum_metric += float((topk == l[:, None]).any(axis=1).sum())
+            self.num_inst += len(l)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            p = _to_np(p)
+            l = _to_np(l).ravel()
+            if p.ndim > 1:
+                p = _numpy.argmax(p, axis=-1)
+            p = p.ravel()
+            self._tp += float(((p == 1) & (l == 1)).sum())
+            self._fp += float(((p == 1) & (l == 0)).sum())
+            self._fn += float(((p == 0) & (l == 1)).sum())
+            self.num_inst += len(l)
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return (self.name, f1 if self.num_inst else float("nan"))
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (binary)."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            p = _to_np(p)
+            l = _to_np(l).ravel()
+            if p.ndim > 1:
+                p = _numpy.argmax(p, axis=-1)
+            p = p.ravel()
+            self._tp += float(((p == 1) & (l == 1)).sum())
+            self._fp += float(((p == 1) & (l == 0)).sum())
+            self._fn += float(((p == 0) & (l == 1)).sum())
+            self._tn += float(((p == 0) & (l == 0)).sum())
+            self.num_inst += len(l)
+
+    def get(self):
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        denom = _numpy.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        mcc = (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+        return (self.name, mcc if self.num_inst else float("nan"))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            l, p = _to_np(l), _to_np(p)
+            l = _align_label(l, p)
+            self.sum_metric += float(_numpy.abs(l - p).mean()) * len(p)
+            self.num_inst += len(p)
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            l, p = _to_np(l), _to_np(p)
+            l = _align_label(l, p)
+            self.sum_metric += float(((l - p) ** 2).mean()) * len(p)
+            self.num_inst += len(p)
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        EvalMetric.__init__(self, name)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(_numpy.sqrt(self.sum_metric / self.num_inst)))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            l = _to_np(l).astype(_numpy.int64).ravel()
+            p = _to_np(p).reshape(len(l), -1)
+            prob = p[_numpy.arange(len(l)), l]
+            self.sum_metric += float(-_numpy.log(prob + self.eps).sum())
+            self.num_inst += len(l)
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            l = _to_np(l).astype(_numpy.int64).ravel()
+            p = _to_np(p).reshape(len(l), -1)
+            prob = p[_numpy.arange(len(l)), l]
+            if self.ignore_label is not None:
+                keep = l != self.ignore_label
+                prob = prob[keep]
+            self.sum_metric += float(-_numpy.log(prob + self.eps).sum())
+            self.num_inst += len(prob)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(_numpy.exp(self.sum_metric / self.num_inst)))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels: List[_numpy.ndarray] = []
+        self._preds: List[_numpy.ndarray] = []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            self._labels.append(_to_np(l).ravel())
+            self._preds.append(_to_np(p).ravel())
+            self.num_inst += len(self._labels[-1])
+
+    def get(self):
+        if not self._labels:
+            return (self.name, float("nan"))
+        l = _numpy.concatenate(self._labels)
+        p = _numpy.concatenate(self._preds)
+        return (self.name, float(_numpy.corrcoef(l, p)[0, 1]))
+
+
+@register
+class Loss(EvalMetric):
+    """Running mean of loss values (reference ``metric.Loss``)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        if isinstance(preds, (NDArray, _numpy.ndarray)):
+            preds = [preds]
+        for p in preds:
+            p = _to_np(p)
+            self.sum_metric += float(p.sum())
+            self.num_inst += p.size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in self.metrics:
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for l, p in zip(labels, preds):
+            out = self._feval(_to_np(l), _to_np(p))
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += out
+                self.num_inst += 1
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    """Wrap a numpy feval as a metric (reference ``metric.np``)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = getattr(numpy_feval, "__name__", name)
+    return CustomMetric(feval, name=feval.__name__,
+                        allow_extra_outputs=allow_extra_outputs)

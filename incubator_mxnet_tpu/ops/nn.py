@@ -27,6 +27,19 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _ntuple(v, n):
+    if isinstance(v, (tuple, list)):
+        t = tuple(int(x) for x in v)
+        return t if len(t) == n else (t * n)[:n]
+    return (int(v),) * n
+
+
+# spatial rank -> conv dimension spec (NC + spatial, reference NCHW family)
+_CONV_SPECS = {1: ("NCW", "OIW", "NCW"),
+               2: ("NCHW", "OIHW", "NCHW"),
+               3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
 # ---------------------------------------------------------------------------
 # Dense / conv / pooling
 # ---------------------------------------------------------------------------
@@ -47,75 +60,89 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
 def convolution(x, weight, bias=None, kernel=None, stride=(1, 1), pad=(0, 0),
                 dilate=(1, 1), num_filter=None, num_group=1, no_bias=False,
                 layout="NCHW"):
-    """Reference src/operator/nn/convolution.cc (cuDNN path). NCHW in/out,
-    weight (O, I/g, kH, kW). Grouped conv via feature_group_count."""
-    stride, pad, dilate = _pair(stride), _pair(pad), _pair(dilate)
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
+    """Reference src/operator/nn/convolution.cc (cuDNN path). NC+spatial
+    in/out (1/2/3-D), weight (O, I/g, *k). Grouped conv via
+    feature_group_count."""
+    nsp = x.ndim - 2
+    stride = _ntuple(stride, nsp)
+    pad = _ntuple(pad, nsp)
+    dilate = _ntuple(dilate, nsp)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _CONV_SPECS[nsp])
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride,
-        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group)
     if bias is not None and not no_bias:
-        y = y + bias.reshape(1, -1, 1, 1)
+        y = y + bias.reshape((1, -1) + (1,) * nsp)
     return y
 
 
 @register("Deconvolution", aliases=("deconvolution",))
 def deconvolution(x, weight, bias=None, kernel=None, stride=(1, 1),
-                  pad=(0, 0), adj=(0, 0), num_filter=None, num_group=1,
-                  no_bias=False):
+                  pad=(0, 0), adj=(0, 0), dilate=(1, 1), num_filter=None,
+                  num_group=1, no_bias=False):
     """Transposed convolution (reference src/operator/nn/deconvolution.cc).
-    Weight (I, O/g, kH, kW) like the reference."""
-    stride, pad, adj = _pair(stride), _pair(pad), _pair(adj)
-    kh, kw = weight.shape[2], weight.shape[3]
-    pads = [(kh - 1 - pad[0], kh - 1 - pad[0] + adj[0]),
-            (kw - 1 - pad[1], kw - 1 - pad[1] + adj[1])]
+    NC+spatial (1/2/3-D); weight (I, O/g, *k) like the reference."""
+    nsp = x.ndim - 2
+    stride = _ntuple(stride, nsp)
+    pad = _ntuple(pad, nsp)
+    adj = _ntuple(adj, nsp)
+    dilate = _ntuple(dilate, nsp)
+    ks = weight.shape[2:]
+    # effective kernel extent accounts for dilation
+    eff = [d * (k - 1) + 1 for k, d in zip(ks, dilate)]
+    pads = [(e - 1 - p, e - 1 - p + a) for e, p, a in zip(eff, pad, adj)]
     if num_group != 1:
         xs = jnp.split(x, num_group, axis=1)
         ws = jnp.split(weight, num_group, axis=0)
-        ys = [_deconv_one(a, w, stride, pads) for a, w in zip(xs, ws)]
+        ys = [_deconv_one(a, w, stride, pads, dilate)
+              for a, w in zip(xs, ws)]
         y = jnp.concatenate(ys, axis=1)
     else:
-        y = _deconv_one(x, weight, stride, pads)
+        y = _deconv_one(x, weight, stride, pads, dilate)
     if bias is not None and not no_bias:
-        y = y + bias.reshape(1, -1, 1, 1)
+        y = y + bias.reshape((1, -1) + (1,) * nsp)
     return y
 
 
-def _deconv_one(x, weight, stride, pads):
-    w = jnp.flip(weight, (2, 3)).transpose(1, 0, 2, 3)  # -> (O, I, kH, kW)
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+def _deconv_one(x, weight, stride, pads, dilate):
+    nsp = x.ndim - 2
+    spatial = tuple(range(2, 2 + nsp))
+    w = jnp.flip(weight, spatial)
+    w = jnp.moveaxis(w, 0, 1)  # (I, O/g, *k) -> (O/g, I, *k)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _CONV_SPECS[nsp])
     return lax.conv_general_dilated(
-        x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
-        dimension_numbers=dn)
+        x, w, window_strides=(1,) * nsp, padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilate, dimension_numbers=dn)
 
 
 @register("Pooling", aliases=("pooling",))
 def pooling(x, kernel=(2, 2), pool_type="max", stride=None, pad=(0, 0),
             global_pool=False, count_include_pad=True, pooling_convention="valid"):
-    """Reference src/operator/nn/pooling.cc. NCHW."""
+    """Reference src/operator/nn/pooling.cc. NC+spatial (1/2/3-D)."""
+    nsp = x.ndim - 2
+    spatial = tuple(range(2, x.ndim))
     if global_pool:
         if pool_type == "max":
-            return jnp.max(x, axis=(2, 3), keepdims=True)
-        return jnp.mean(x, axis=(2, 3), keepdims=True)
-    kernel = _pair(kernel)
-    stride = _pair(stride) if stride is not None else kernel
-    pad = _pair(pad)
+            return jnp.max(x, axis=spatial, keepdims=True)
+        return jnp.mean(x, axis=spatial, keepdims=True)
+    kernel = _ntuple(kernel, nsp)
+    stride = _ntuple(stride, nsp) if stride is not None else kernel
+    pad = _ntuple(pad, nsp)
     dims = (1, 1) + kernel
     strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pooling_convention == "full":
-        # ceil-mode: extend right/bottom padding so the last window fits
+        # ceil-mode: extend trailing padding so the last window fits
         extra = []
         for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
             n = x.shape[2 + i]
             out = -(-(n + 2 * p - k) // s) + 1  # ceil
             need = (out - 1) * s + k - (n + 2 * p)
             extra.append(max(0, need))
-        padding = ((0, 0), (0, 0), (pad[0], pad[0] + extra[0]),
-                   (pad[1], pad[1] + extra[1]))
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad, extra))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -125,7 +152,10 @@ def pooling(x, kernel=(2, 2), pool_type="max", stride=None, pad=(0, 0),
         if pool_type == "sum":
             return s
         if count_include_pad:
-            return s / (kernel[0] * kernel[1])
+            k_elems = 1
+            for k in kernel:
+                k_elems *= k
+            return s / k_elems
         ones = jnp.ones_like(x)
         cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
         return s / cnt

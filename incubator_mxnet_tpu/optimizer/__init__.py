@@ -1,0 +1,5 @@
+"""Optimizers (reference ``python/mxnet/optimizer/``)."""
+
+from .optimizer import (SGD, NAG, AdaDelta, AdaGrad, Adam, AdamW, DCASGD,
+                        Ftrl, LAMB, LARS, Optimizer, RMSProp, SGLD, Signum,
+                        Updater, create, get_updater, register)

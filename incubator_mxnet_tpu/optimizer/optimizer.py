@@ -1,0 +1,756 @@
+"""Optimizer library.
+
+Capability parity with reference ``python/mxnet/optimizer/optimizer.py`` +
+``src/operator/optimizer_op.cc`` (SURVEY.md §2.2 "Optimizers"): SGD(+momentum),
+NAG, Adam/AdamW, AdaGrad, AdaDelta, RMSProp, Ftrl, LAMB, Signum, SGLD, DCASGD,
+LARS; per-param lr/wd multipliers, rescale_grad, clip_gradient, wd, lr
+schedulers, and ``multi_precision`` (fp32 master weights for fp16/bf16
+params).
+
+TPU-native redesign: the reference implements each update as a fused CUDA
+kernel (``sgd_mom_update`` etc.). Here each update rule is a pure jax function
+jitted once per (shape, dtype) — XLA fuses the whole update chain (rescale +
+clip + wd + rule) into one kernel, and donated buffers make it in-place in
+HBM, which is the ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` multi-tensor trick's
+moral equivalent.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ndarray import NDArray
+
+_OPTIMIZERS: Dict[str, type] = {}
+
+
+def register(cls):
+    """Register an Optimizer subclass under its lowercased name (reference
+    ``Optimizer.register``)."""
+    _OPTIMIZERS[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    if name.lower() not in _OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}")
+    return _OPTIMIZERS[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference ``mxnet.optimizer.Optimizer``)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self._lr_mult: Dict[Any, float] = {}
+        self._wd_mult: Dict[Any, float] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # -- schedules / multipliers -------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("lr_scheduler is set; use it instead")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult: Dict[Any, float]):
+        self._lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[Any, float]):
+        self._wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _get_lr(self, index) -> float:
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= getattr(p, "lr_mult", 1.0)
+        elif index in self._lr_mult:
+            lr *= self._lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self._lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= getattr(p, "wd_mult", 1.0)
+        elif index in self._wd_mult:
+            wd *= self._wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self._wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight.dtype in (jnp.float16,
+                                                     jnp.bfloat16):
+            master = jnp.asarray(weight._data, jnp.float32)
+            return (master, self.create_state(index, weight))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight: NDArray, grad: NDArray,
+                               state):
+        if self.multi_precision and isinstance(state, tuple) \
+                and len(state) == 2 and isinstance(state[0], jax.Array) \
+                and state[0].dtype == jnp.float32 \
+                and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, inner = state
+            master_nd = NDArray(master, ctx=weight.ctx)
+            grad32 = NDArray(jnp.asarray(grad._data, jnp.float32),
+                             ctx=grad.ctx)
+            new_state = self.update(index, master_nd, grad32, inner)
+            weight._set_data(jnp.asarray(master_nd._data, weight.dtype))
+            return (master_nd._data, new_state)
+        return self.update(index, weight, grad, state)
+
+    # -- jit plumbing --------------------------------------------------------
+    def _run(self, key, fn, weight: NDArray, grad, state_arrays, scalars):
+        """Jit-cached execution of an update rule.
+
+        ``fn(w, g, *states, **scalars) -> (new_w, new_states)``; scalars
+        (lr, wd, t, ...) are passed as traced args so one executable serves
+        every step and every layer of the same shape.
+        """
+        # rescale_grad/clip_gradient are captured in the rule closures, so
+        # they are part of the executable identity: keying on them makes a
+        # changed rescale (e.g. Trainer.step with a partial final batch)
+        # recompile instead of silently reusing the stale constant.
+        cache_key = (type(self).__name__, key, weight.shape,
+                     str(weight.dtype), tuple(s.shape for s in state_arrays),
+                     float(self.rescale_grad), self.clip_gradient)
+        jfn = self._jit_cache.get(cache_key)
+        if jfn is None:
+            # donate weight + states (in-place update in HBM); grad NOT
+            # donated — the grad buffer outlives the step (user-inspectable)
+            jfn = jax.jit(fn, donate_argnums=(0,) + tuple(
+                range(2, 2 + len(state_arrays))))
+            self._jit_cache[cache_key] = jfn
+        new_w, new_states = jfn(weight._data, grad, *state_arrays,
+                                **{k: jnp.asarray(v, jnp.float32)
+                                   for k, v in scalars.items()})
+        weight._set_data(new_w)
+        return new_states
+
+    # -- (de)serialization ---------------------------------------------------
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_jit_cache"] = {}
+        return d
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional lazy/multi-precision (reference
+    ``sgd_update``/``sgd_mom_update``/``mp_sgd_update`` kernels)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
+            self.momentum
+
+        if state is None:
+            def fn(w, g, lr, wd):
+                g = g.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wd.astype(w.dtype) * w
+                return w - lr.astype(w.dtype) * g, ()
+
+            self._run("sgd", fn, weight, grad._data, (),
+                      dict(lr=lr, wd=wd))
+            return None
+
+        def fn(w, g, m, lr, wd):
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            m = mom * m - lr.astype(w.dtype) * g
+            return w + m, (m,)
+
+        (new_m,) = self._run("sgd_mom", fn, weight, grad._data, (state,),
+                             dict(lr=lr, wd=wd))
+        return new_m
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference ``nag_mom_update``)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
+            self.momentum
+
+        if state is None:
+            def fn(w, g, lr, wd):
+                g = g.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wd.astype(w.dtype) * w
+                return w - lr.astype(w.dtype) * g, ()
+
+            self._run("nag0", fn, weight, grad._data, (),
+                      dict(lr=lr, wd=wd))
+            return None
+
+        def fn(w, g, m, lr, wd):
+            lr = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            m = mom * m + g
+            return w - lr * (g + mom * m), (m,)
+
+        (new_m,) = self._run("nag", fn, weight, grad._data, (state,),
+                             dict(lr=lr, wd=wd))
+        return new_m
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference ``adam_update``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        m, v = state
+
+        def fn(w, g, m, v, lr, wd):
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            w = w - lr.astype(w.dtype) * m / (jnp.sqrt(v) + eps)
+            return w, (m, v)
+
+        return self._run("adam", fn, weight, grad._data, (m, v),
+                         dict(lr=lr, wd=wd))
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam (reference contrib ``adamw_update``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        correction = math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        m, v = state
+
+        def fn(w, g, m, v, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            w = w - lr_t * (correction * m / (jnp.sqrt(v) + eps)
+                            + wd.astype(w.dtype) * w)
+            return w, (m, v)
+
+        return self._run("adamw", fn, weight, grad._data, (m, v),
+                         dict(lr=lr, wd=wd))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        eps, rescale, clip = self.float_stable_eps, self.rescale_grad, \
+            self.clip_gradient
+
+        def fn(w, g, h, lr, wd):
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            h = h + jnp.square(g)
+            w = w - lr.astype(w.dtype) * g / (jnp.sqrt(h) + eps)
+            return w, (h,)
+
+        (new_h,) = self._run("adagrad", fn, weight, grad._data, (state,),
+                             dict(lr=lr, wd=wd))
+        return new_h
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        rho, eps = self.rho, self.epsilon
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        acc_g, acc_d = state
+
+        def fn(w, g, ag, ad, lr, wd):
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            ag = rho * ag + (1 - rho) * jnp.square(g)
+            d = jnp.sqrt(ad + eps) / jnp.sqrt(ag + eps) * g
+            ad = rho * ad + (1 - rho) * jnp.square(d)
+            return w - d, (ag, ad)
+
+        return self._run("adadelta", fn, weight, grad._data, (acc_g, acc_d),
+                         dict(lr=0.0, wd=wd))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, plain and centered (reference ``rmsprop_update`` /
+    ``rmspropalex_update``)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (jnp.zeros(weight.shape, weight.dtype),
+                    jnp.zeros(weight.shape, weight.dtype),
+                    jnp.zeros(weight.shape, weight.dtype))
+        return (jnp.zeros(weight.shape, weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        cw = self.clip_weights
+
+        if self.centered:
+            n, gbar, delta = state
+
+            def fn(w, g, n, gb, d, lr, wd):
+                lr_t = lr.astype(w.dtype)
+                g = g.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wd.astype(w.dtype) * w
+                n = g1 * n + (1 - g1) * jnp.square(g)
+                gb = g1 * gb + (1 - g1) * g
+                d = g2 * d - lr_t * g / jnp.sqrt(n - jnp.square(gb) + eps)
+                w = w + d
+                if cw is not None:
+                    w = jnp.clip(w, -cw, cw)
+                return w, (n, gb, d)
+
+            return self._run("rmsprop_c", fn, weight, grad._data,
+                             (n, gbar, delta), dict(lr=lr, wd=wd))
+
+        (n,) = state
+
+        def fn(w, g, n, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            n = g1 * n + (1 - g1) * jnp.square(g)
+            w = w - lr_t * g / jnp.sqrt(n + eps)
+            if cw is not None:
+                w = jnp.clip(w, -cw, cw)
+            return w, (n,)
+
+        return self._run("rmsprop", fn, weight, grad._data, (n,),
+                         dict(lr=lr, wd=wd))
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        l1, beta = self.lamda1, self.beta
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        z, n = state
+
+        def fn(w, g, z, n, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr_t
+            z = z + g - sigma * w
+            n = n + jnp.square(g)
+            w = jnp.where(
+                jnp.abs(z) > l1,
+                -(z - jnp.sign(z) * l1)
+                / ((beta + jnp.sqrt(n)) / lr_t + wd.astype(w.dtype)),
+                0.0)
+            return w, (z, n)
+
+        return self._run("ftrl", fn, weight, grad._data, (z, n),
+                         dict(lr=lr, wd=wd))
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference
+    ``lamb_update_phase1/2``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        bc = self.bias_correction
+        lb, ub = self.lower_bound, self.upper_bound
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        m, v = state
+
+        def fn(w, g, m, v, lr, wd, t):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            if bc:
+                mhat = m / (1 - jnp.power(b1, t).astype(w.dtype))
+                vhat = v / (1 - jnp.power(b2, t).astype(w.dtype))
+            else:
+                mhat, vhat = m, v
+            u = mhat / (jnp.sqrt(vhat) + eps) + wd.astype(w.dtype) * w
+            wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+            unorm = jnp.linalg.norm(u.astype(jnp.float32))
+            if lb is not None:
+                wnorm = jnp.maximum(wnorm, lb)
+            if ub is not None:
+                wnorm = jnp.minimum(wnorm, ub)
+            ratio = jnp.where((wnorm > 0) & (unorm > 0),
+                              wnorm / unorm, 1.0).astype(w.dtype)
+            return w - lr_t * ratio * u, (m, v)
+
+        return self._run("lamb", fn, weight, grad._data, (m, v),
+                         dict(lr=lr, wd=wd, t=float(t)))
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference contrib LARS)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, eta, eps = self.momentum, self.eta, self.epsilon
+        rescale, clip = self.rescale_grad, self.clip_gradient
+
+        def fn(w, g, m, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+            gnorm = jnp.linalg.norm(g.astype(jnp.float32))
+            trust = jnp.where(
+                (wnorm > 0) & (gnorm > 0),
+                eta * wnorm / (gnorm + wd * wnorm + eps), 1.0).astype(w.dtype)
+            g = g + wd.astype(w.dtype) * w
+            m = mom * m + trust * lr_t * g
+            return w - m, (m,)
+
+        (new_m,) = self._run("lars", fn, weight, grad._data, (state,),
+                             dict(lr=lr, wd=wd))
+        return new_m
+
+
+@register
+class Signum(Optimizer):
+    """Sign-SGD with momentum (reference ``signum_update``)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, wd_lh = self.momentum, self.wd_lh
+        rescale, clip = self.rescale_grad, self.clip_gradient
+
+        if state is None:
+            def fn(w, g, lr, wd):
+                g = g.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wd.astype(w.dtype) * w
+                return w - lr.astype(w.dtype) * jnp.sign(g), ()
+
+            self._run("signsgd", fn, weight, grad._data, (),
+                      dict(lr=lr, wd=wd))
+            return None
+
+        def fn(w, g, m, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            m = mom * m - (1 - mom) * g
+            w = w * (1 - lr_t * wd_lh) + lr_t * jnp.sign(m)
+            return w, (m,)
+
+        (new_m,) = self._run("signum", fn, weight, grad._data, (state,),
+                             dict(lr=lr, wd=wd))
+        return new_m
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        key = _random.next_key()
+
+        def fn(w, g, key, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            noise = jax.random.normal(key, w.shape, w.dtype) \
+                * jnp.sqrt(lr).astype(w.dtype)
+            return w - 0.5 * lr_t * g + noise, ()
+
+        jfn = self._jit_cache.get(("sgld", weight.shape, str(weight.dtype)))
+        if jfn is None:
+            jfn = jax.jit(fn)
+            self._jit_cache[("sgld", weight.shape, str(weight.dtype))] = jfn
+        new_w, _ = jfn(weight._data, grad._data, key,
+                       jnp.asarray(lr, jnp.float32),
+                       jnp.asarray(wd, jnp.float32))
+        weight._set_data(new_w)
+        return None
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        # copy=True: the state must not alias the (donated) weight buffer
+        return (jnp.zeros(weight.shape, weight.dtype),
+                jnp.array(weight._data, copy=True))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, lamda = self.momentum, self.lamda
+        rescale, clip = self.rescale_grad, self.clip_gradient
+        m, prev_w = state
+
+        def fn(w, g, m, pw, lr, wd):
+            lr_t = lr.astype(w.dtype)
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd.astype(w.dtype) * w
+            g = g + lamda * g * g * (w - pw)
+            m = mom * m - lr_t * g
+            return w + m, (m, w)
+
+        return self._run("dcasgd", fn, weight, grad._data, (m, prev_w),
+                         dict(lr=lr, wd=wd))
+
+
+class Updater:
+    """State-managing update callable (reference ``mxnet.optimizer.Updater``,
+    the kvstore ``set_updater`` target)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad: NDArray, weight: NDArray):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.states[index] = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps((
+            {k: jax.tree_util.tree_map(lambda a: np.asarray(a), v)
+             for k, v in self.states.items()},
+            self.optimizer if dump_optimizer else None))
+
+    def set_states(self, states):
+        import pickle
+
+        st, opt = pickle.loads(states)
+        self.states = {
+            k: jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a,
+                v)
+            for k, v in st.items()}
+        if opt is not None:
+            self.optimizer = opt
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,20 @@
+"""Parallelism: meshes, SPMD training, collectives.
+
+This package is the TPU-native replacement for the reference's entire
+communication stack (SURVEY.md §2.4): kvstore device/NCCL rings, ps-lite
+parameter server, CUDA P2P tree reduce, and the engine-mediated
+compute/comm overlap. Here a ``jax.sharding.Mesh`` + ``pjit`` partitioning
+does all of it: gradients AllReduce over ICI because the data axis is
+sharded, tensor-parallel layers ReduceScatter/AllGather because their
+parameters carry ``PartitionSpec`` rules, and overlap comes from XLA's async
+collectives and latency-hiding scheduler.
+
+Axes convention (scaling-book style): ``data`` (DP), ``model`` (TP),
+``seq`` (SP/CP), ``expert`` (EP, reserved), ``pipe`` (PP, reserved).
+"""
+
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, current_mesh, make_mesh,
+                   mesh_scope)
+from .collectives import (allreduce_across_processes, init_distributed,
+                          pmean, psum)
+from .spmd import SPMDTrainer, shard_params

@@ -1,0 +1,50 @@
+"""Collectives and multi-host initialization.
+
+Reference equivalents: ``kvstore_nccl.cc`` AllReduce -> ``jax.lax.psum``
+inside pjit/shard_map; ps-lite tracker rendezvous (``tools/launch.py`` DMLC_*
+env) -> ``jax.distributed.initialize``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host rendezvous (the DMLC tracker analog). Arguments default to
+    the standard JAX env vars; call once per process before any computation."""
+    global _initialized
+    if _initialized:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def psum(x, axis_name: str):
+    """AllReduce-sum over a mesh axis (use inside shard_map/pjit)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return jax.lax.pmean(x, axis_name)
+
+
+def allreduce_across_processes(x: jax.Array) -> jax.Array:
+    """Sum an identically-shaped host-local array across all processes
+    (kvstore dist_sync push aggregation). Single-process: identity."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(x)
+    return jnp.sum(gathered, axis=0)

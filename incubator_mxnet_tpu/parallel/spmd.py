@@ -1,0 +1,224 @@
+"""SPMD training: one jitted step over a device mesh.
+
+This is the performance path of the framework — the analog of the reference's
+north-star stack (SURVEY.md §3.2 + §3.3 combined): CachedOp forward +
+backward + kvstore allreduce + optimizer update, fused into ONE XLA
+computation partitioned over a Mesh. Gradients AllReduce over ICI because
+the batch is sharded on the ``data`` axis; tensor-parallel parameters shard
+per their ``PartitionSpec`` rules; XLA overlaps the collectives with backward
+compute (replacing the reference's engine-mediated comm/compute overlap).
+
+Optimizers here are optax transformations (idiomatic jax); the imperative
+``mx.optimizer`` names map onto them, so ``SPMDTrainer(net, loss, 'sgd',
+{'learning_rate': .1, 'momentum': .9})`` matches ``gluon.Trainer`` semantics.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import autograd
+from .. import random as _random
+from ..gluon.parameter import Parameter, _trace
+from ..gluon.block import _Trace
+from ..ndarray import NDArray
+from .mesh import DATA_AXIS, make_mesh
+
+
+def _to_optax(optimizer, optimizer_params: Optional[dict]):
+    """Map mx optimizer names/objects to optax transformations."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    p = dict(optimizer_params or {})
+    lr = p.pop("learning_rate", 0.01)
+    wd = p.pop("wd", 0.0)
+    name = optimizer.lower() if isinstance(optimizer, str) else None
+    if name == "sgd":
+        mom = p.pop("momentum", 0.0)
+        tx = optax.sgd(lr, momentum=mom if mom else None)
+    elif name == "nag":
+        tx = optax.sgd(lr, momentum=p.pop("momentum", 0.9), nesterov=True)
+    elif name == "adam":
+        tx = optax.adam(lr, b1=p.pop("beta1", 0.9), b2=p.pop("beta2", 0.999),
+                        eps=p.pop("epsilon", 1e-8))
+    elif name == "adamw":
+        tx = optax.adamw(lr, b1=p.pop("beta1", 0.9),
+                         b2=p.pop("beta2", 0.999),
+                         eps=p.pop("epsilon", 1e-8), weight_decay=wd)
+        wd = 0.0
+    elif name == "lamb":
+        tx = optax.lamb(lr, b1=p.pop("beta1", 0.9), b2=p.pop("beta2", 0.999),
+                        eps=p.pop("epsilon", 1e-6), weight_decay=wd)
+        wd = 0.0
+    elif name == "rmsprop":
+        tx = optax.rmsprop(lr, decay=p.pop("gamma1", 0.9),
+                           eps=p.pop("epsilon", 1e-8))
+    elif name == "adagrad":
+        tx = optax.adagrad(lr, eps=p.pop("eps", 1e-7))
+    else:
+        raise ValueError(f"no optax mapping for optimizer {optimizer!r}")
+    if wd:
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    clip = p.pop("clip_gradient", None)
+    if clip is not None:
+        tx = optax.chain(optax.clip(clip), tx)
+    return tx
+
+
+def shard_params(net, rules: Dict[str, PartitionSpec]) -> None:
+    """Attach PartitionSpec sharding rules to parameters by regex on the
+    structural name — the TP/SP analog of the reference's ``group2ctx``
+    manual placement (SURVEY.md §2.4 TP row)."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules.items()]
+    for name, p in net._collect_params_with_prefix().items():
+        for pat, spec in compiled:
+            if pat.search(name):
+                p._sharding = spec
+                break
+
+
+class SPMDTrainer:
+    """Own the params as a sharded pytree; run fused jitted train steps.
+
+    Usage::
+
+        mesh = parallel.make_mesh({'data': -1})
+        st = parallel.SPMDTrainer(net, loss_fn, 'sgd',
+                                  {'learning_rate': 0.1}, mesh=mesh)
+        loss = st.step(x, y)          # x, y: NDArray/np — sharded on 'data'
+        st.sync_to_net()              # write params back into the Block
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS,
+                 loss_has_aux_inputs: int = 1, donate: bool = True):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.data_axis = data_axis
+        self.tx = _to_optax(optimizer, optimizer_params)
+        self._step_cache: Dict[Any, Callable] = {}
+        self._num_steps = 0
+        self._donate = donate
+
+        by_name = net._collect_params_with_prefix()
+        self._param_objs: "OrderedDict[str, Parameter]" = OrderedDict()
+        seen = set()
+        for name, p in by_name.items():
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            if p._data is None:
+                raise RuntimeError(
+                    f"parameter {name} not initialized; run one eager "
+                    "forward (or pass explicit shapes) before SPMDTrainer")
+            self._param_objs[name] = p
+        self._trainable = {n: p for n, p in self._param_objs.items()
+                           if p.grad_req != "null"}
+        self._frozen = {n: p for n, p in self._param_objs.items()
+                        if p.grad_req == "null"}
+
+        # place params on the mesh per their rules (default: replicated)
+        def shard_of(p):
+            spec = p._sharding if p._sharding is not None else PartitionSpec()
+            return NamedSharding(self.mesh, spec)
+
+        self.params = {n: jax.device_put(p._data._data, shard_of(p))
+                       for n, p in self._trainable.items()}
+        self.frozen = {n: jax.device_put(p._data._data, shard_of(p))
+                       for n, p in self._frozen.items()}
+        self.opt_state = self.tx.init(self.params)
+        self._batch_sharding = NamedSharding(self.mesh,
+                                             PartitionSpec(data_axis))
+
+    # -- the fused step -----------------------------------------------------
+    def _build_step(self, n_data: int, n_label: int):
+        net, loss_fn, tx = self.net, self.loss_fn, self.tx
+        trainable_objs = self._trainable
+        frozen_objs = self._frozen
+
+        def loss_of(train_p, frozen_p, rng, data_arrays, label_arrays):
+            param_map = {}
+            for n, p in trainable_objs.items():
+                param_map[id(p)] = NDArray(train_p[n])
+            for n, p in frozen_objs.items():
+                param_map[id(p)] = NDArray(frozen_p[n])
+            trace = _Trace(param_map)
+            _trace.stack.append(trace)
+            try:
+                with _random.key_provider(rng), \
+                        autograd._RecordingStateScope(False, True):
+                    ins = [NDArray(a) for a in data_arrays]
+                    out = net.forward(*ins)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    labels = [NDArray(a) for a in label_arrays]
+                    loss = loss_fn(*outs, *labels)
+            finally:
+                _trace.stack.pop()
+            loss_val = jnp.mean(loss._data.astype(jnp.float32))
+            id2name = {id(p): n for n, p in frozen_objs.items()}
+            id2name.update({id(p): n for n, p in trainable_objs.items()})
+            aux = {id2name[i]: v for i, (p, v) in trace.aux.items()
+                   if i in id2name}
+            return loss_val, aux
+
+        def step(train_p, frozen_p, opt_state, rng, data_arrays,
+                 label_arrays):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p, frozen_p, rng, data_arrays,
+                                       label_arrays)
+            updates, opt_state = tx.update(grads, opt_state, train_p)
+            train_p = optax.apply_updates(train_p, updates)
+            for n, v in aux.items():
+                if n in frozen_p:
+                    frozen_p = {**frozen_p, n: v}
+                elif n in train_p:
+                    train_p = {**train_p, n: v}
+            return train_p, frozen_p, opt_state, loss
+
+        return jax.jit(step,
+                       donate_argnums=(0, 1, 2) if self._donate else ())
+
+    @staticmethod
+    def _as_jax(x):
+        if isinstance(x, NDArray):
+            return x._data
+        return jnp.asarray(x)
+
+    def step(self, data, labels) -> float:
+        """One fused forward+backward+update step. ``data``/``labels`` may be
+        a single array or a list; they are sharded along the data axis."""
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        data_arrays = [jax.device_put(self._as_jax(d), self._batch_sharding)
+                       for d in data]
+        label_arrays = [jax.device_put(self._as_jax(l), self._batch_sharding)
+                        for l in labels]
+        key = (tuple((a.shape, str(a.dtype)) for a in data_arrays),
+               tuple((a.shape, str(a.dtype)) for a in label_arrays))
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_step(len(data_arrays), len(label_arrays))
+            self._step_cache[key] = fn
+        self._num_steps += 1
+        rng = _random.next_key()
+        self.params, self.frozen, self.opt_state, loss = fn(
+            self.params, self.frozen, self.opt_state, rng, data_arrays,
+            label_arrays)
+        return loss
+
+    def sync_to_net(self) -> None:
+        """Write the trainer-owned arrays back into the Block's Parameters
+        (for save_parameters / eager inference)."""
+        for n, p in self._trainable.items():
+            p._data._set_data(self.params[n])
+        for n, p in self._frozen.items():
+            p._data._set_data(self.frozen[n])

@@ -24,9 +24,34 @@ class _RandomState(threading.local):
     def __init__(self):
         self.key = jax.random.PRNGKey(0)
         self.counter = 0
+        self.providers = []  # trace-time key providers (CachedOp pushes one)
 
 
 _rs = _RandomState()
+
+
+class key_provider:
+    """Context manager routing ``next_key()`` to an explicit source.
+
+    Used by the CachedOp tracer (gluon/block.py): inside a jitted forward the
+    global key would be baked in as a constant (same dropout mask forever), so
+    the trace threads an ``rng`` argument and ops draw folded sub-keys of it.
+    """
+
+    def __init__(self, base_key):
+        self._base = base_key
+        self._count = 0
+
+    def __call__(self):
+        self._count += 1
+        return jax.random.fold_in(self._base, self._count)
+
+    def __enter__(self):
+        _rs.providers.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _rs.providers.pop()
 
 
 def seed(seed_state: int, ctx: str = "all") -> None:
@@ -40,6 +65,8 @@ def seed(seed_state: int, ctx: str = "all") -> None:
 
 def next_key():
     """Draw a fresh PRNG key for one op invocation."""
+    if _rs.providers:
+        return _rs.providers[-1]()
     _rs.counter += 1
     return jax.random.fold_in(_rs.key, _rs.counter)
 

@@ -9,11 +9,18 @@ analog of the reference's multi-process-on-one-box launcher tests.
 import os
 
 # Must run before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The environment's sitecustomize force-registers the axon TPU plugin and
+# overrides JAX_PLATFORMS; re-override so the test suite runs on the
+# 8-virtual-device CPU backend (fast, and required for mesh tests).
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,309 @@
+"""Losses, optimizers, metrics, io iterators — numpy-oracle tests
+(reference test strategy SURVEY.md §4: tests/python/unittest/test_loss.py,
+test_optimizer.py, test_metric.py, test_io.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, metric
+from incubator_mxnet_tpu.gluon import loss as gloss
+from incubator_mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def test_l2_loss():
+    pred = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.nd.array([[1.5, 2.0], [3.0, 3.0]])
+    out = gloss.L2Loss()(pred, label).asnumpy()
+    expect = ((np.array([[0.5, 0], [0, 1.0]]) ** 2) / 2).mean(axis=1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_l1_loss():
+    pred = mx.nd.array([[1.0, -2.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    np.testing.assert_allclose(gloss.L1Loss()(pred, label).asnumpy(), [1.5],
+                               rtol=1e-6)
+
+
+def test_softmax_ce_loss_sparse_and_dense():
+    logits_np = np.random.rand(6, 5).astype(np.float32)
+    labels_np = np.random.randint(0, 5, (6,))
+    logits = mx.nd.array(logits_np)
+    # sparse labels
+    l1 = gloss.SoftmaxCrossEntropyLoss()(logits, mx.nd.array(labels_np))
+    logp = logits_np - np.log(
+        np.exp(logits_np).sum(-1, keepdims=True))
+    expect = -logp[np.arange(6), labels_np]
+    np.testing.assert_allclose(l1.asnumpy(), expect, rtol=1e-4)
+    # dense one-hot labels
+    onehot = np.eye(5, dtype=np.float32)[labels_np]
+    l2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        logits, mx.nd.array(onehot))
+    np.testing.assert_allclose(l2.asnumpy(), expect, rtol=1e-4)
+
+
+def test_sigmoid_bce_loss():
+    pred = mx.nd.array([[0.0, 2.0, -2.0]])
+    label = mx.nd.array([[0.0, 1.0, 0.0]])
+    out = gloss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    p = np.array([[0.0, 2.0, -2.0]])
+    l = np.array([[0.0, 1.0, 0.0]])
+    expect = (np.maximum(p, 0) - p * l + np.log1p(np.exp(-np.abs(p)))).mean(1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_huber_hinge_losses():
+    pred = mx.nd.array([[0.5, 3.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    h = gloss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    np.testing.assert_allclose(h, [(0.5 * 0.25 + (3.0 - 0.5)) / 2], rtol=1e-5)
+    label_s = mx.nd.array([[1.0, -1.0]])
+    hi = gloss.HingeLoss()(pred, label_s).asnumpy()
+    np.testing.assert_allclose(hi, [(0.5 + 4.0) / 2], rtol=1e-5)
+
+
+def test_kl_div_loss():
+    p = np.array([[0.2, 0.3, 0.5]], dtype=np.float32)
+    q = np.array([[0.3, 0.3, 0.4]], dtype=np.float32)
+    out = gloss.KLDivLoss(from_logits=True)(
+        mx.nd.array(np.log(q)), mx.nd.array(p)).asnumpy()
+    expect = (p * (np.log(p + 1e-12) - np.log(q))).mean(axis=1)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_ctc_loss_runs_and_is_positive():
+    pred = mx.nd.uniform(shape=(2, 20, 10))
+    label = mx.nd.array(np.array([[1, 2, 3, -1], [2, 4, -1, -1]],
+                                 dtype=np.float32))
+    out = gloss.CTCLoss()(pred, label)
+    assert out.shape == (2,)
+    assert (out.asnumpy() > 0).all()
+
+
+def test_loss_gradient_flows():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = mx.nd.uniform(shape=(5, 4))
+    y = mx.nd.array(np.random.randint(0, 3, (5,)))
+    with mx.autograd.record():
+        l = gloss.SoftmaxCrossEntropyLoss()(net(x), y)
+    l.backward()
+    assert np.abs(net.weight.grad().asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# optimizers: each reduces a quadratic
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("name,kwargs,steps,bound", [
+    ("sgd", {"learning_rate": 0.1}, 60, 2.0),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, 60, 2.0),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}, 60, 2.0),
+    ("adam", {"learning_rate": 0.1}, 60, 2.0),
+    ("adamw", {"learning_rate": 0.1}, 60, 2.0),
+    ("adagrad", {"learning_rate": 0.5}, 60, 2.0),
+    ("adadelta", {}, 400, 3.0),              # lr-free; slow by design
+    ("rmsprop", {"learning_rate": 0.05}, 60, 2.0),
+    ("rmsprop", {"learning_rate": 0.05, "centered": True}, 60, 2.0),
+    ("ftrl", {"learning_rate": 0.5}, 60, 2.0),
+    ("lamb", {"learning_rate": 0.1}, 60, 2.0),
+    ("lars", {"learning_rate": 0.1, "eta": 0.1}, 200, 2.0),
+    ("signum", {"learning_rate": 0.05}, 120, 2.0),  # fixed step ±lr
+    ("dcasgd", {"learning_rate": 0.1}, 60, 2.0),
+])
+def test_optimizer_reduces_quadratic(name, kwargs, steps, bound):
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create(name, **kwargs)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.array(np.array([3.0, -2.0, 1.5], dtype=np.float32))
+    target = np.zeros(3, dtype=np.float32)
+    for _ in range(steps):
+        g = mx.nd.array(w.asnumpy() - target)  # grad of 0.5||w||^2
+        updater(0, g, w)
+    final = float(np.abs(w.asnumpy()).sum())
+    assert final < bound, f"{name} failed to reduce: {final}"
+
+
+def test_sgd_multi_precision():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    import jax.numpy as jnp
+
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
+                         multi_precision=True)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.array(np.array([1.0, 2.0], dtype=np.float32)).astype("bfloat16")
+    for _ in range(10):
+        g = mx.nd.array(np.array([0.1, 0.1])).astype("bfloat16")
+        updater(0, g, w)
+    assert w.dtype == jnp.bfloat16
+    state = updater.states[0]
+    assert isinstance(state, tuple) and state[0].dtype == jnp.float32
+
+
+def test_optimizer_wd():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("sgd", learning_rate=0.1, wd=0.1)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.array(np.array([1.0], dtype=np.float32))
+    g = mx.nd.zeros((1,))
+    updater(0, g, w)
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - 0.1 * 0.1], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_accuracy_metric():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_topk_metric():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 2])
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_mcc():
+    m = metric.create("f1")
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 0, 1])
+    m.update(label, pred)
+    assert 0 < m.get()[1] <= 1
+    m2 = metric.create("mcc")
+    m2.update(label, pred)
+    assert -1 <= m2.get()[1] <= 1
+
+
+def test_mae_mse_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    m = metric.create("mae")
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(0.5)
+    m = metric.create("rmse")
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_perplexity():
+    m = metric.create("perplexity", ignore_label=None)
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update(label, pred)
+    expect = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert m.get()[1] == pytest.approx(expect, rel=1e-4)
+
+
+def test_composite_metric():
+    m = metric.create(["acc", "mae"])
+    pred = mx.nd.array([[0.1, 0.9]])
+    label = mx.nd.array([1])
+    m.update(label, pred)
+    names, values = m.get()
+    assert len(names) == 2
+
+
+# ---------------------------------------------------------------------------
+# io iterators
+# ---------------------------------------------------------------------------
+def test_ndarray_iter_basic():
+    from incubator_mxnet_tpu.io import NDArrayIter
+
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    from incubator_mxnet_tpu.io import NDArrayIter
+
+    data = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = NDArrayIter(data, None, batch_size=3, shuffle=True,
+                     last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+
+
+def test_ndarray_iter_dict_input():
+    from incubator_mxnet_tpu.io import NDArrayIter
+
+    it = NDArrayIter({"a": np.zeros((4, 2)), "b": np.ones((4, 3))},
+                     batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert names == ["a", "b"]
+    b = next(it)
+    assert b.data[0].shape == (2, 2) and b.data[1].shape == (2, 3)
+
+
+def test_resize_iter():
+    from incubator_mxnet_tpu.io import NDArrayIter, ResizeIter
+
+    data = np.zeros((6, 2), dtype=np.float32)
+    it = ResizeIter(NDArrayIter(data, batch_size=3), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    from incubator_mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    data = np.random.rand(8, 2).astype(np.float32)
+    it = PrefetchingIter(NDArrayIter(data, batch_size=2))
+    assert len(list(it)) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: Gluon MLP on synthetic MNIST-like data (BASELINE config 0 slice)
+# ---------------------------------------------------------------------------
+def test_mlp_mnist_end_to_end():
+    from incubator_mxnet_tpu.io import NDArrayIter
+
+    np.random.seed(0)
+    n, d, k = 512, 64, 10
+    centers = np.random.randn(k, d).astype(np.float32) * 3
+    labels = np.random.randint(0, k, (n,))
+    data = centers[labels] + np.random.randn(n, d).astype(np.float32) * 0.5
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation='relu'),
+            nn.Dense(64, activation='relu'),
+            nn.Dense(k))
+    net.initialize(init='xavier')
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9})
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    train_iter = NDArrayIter(data, labels.astype(np.float32), batch_size=64,
+                             shuffle=True)
+    acc = metric.create("acc")
+    for epoch in range(3):
+        train_iter.reset()
+        acc.reset()
+        for batch in train_iter:
+            x, y = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                out = net(x)
+                l = loss_fn(out, y)
+            l.backward()
+            trainer.step(x.shape[0])
+            acc.update(y, out)
+    assert acc.get()[1] > 0.9, f"final train acc {acc.get()[1]}"
