@@ -114,10 +114,11 @@ class Node:
     """
 
     __slots__ = ("vjp_fn", "parents", "receivers", "n_outputs", "out_avals",
-                 "name", "pure_fn", "in_data", "in_objs")
+                 "name", "pure_fn", "in_data", "in_objs", "pure_tuple")
 
     def __init__(self, vjp_fn, parents, receivers, n_outputs, out_avals,
-                 name="", pure_fn=None, in_data=None, in_objs=None):
+                 name="", pure_fn=None, in_data=None, in_objs=None,
+                 pure_tuple=False):
         self.vjp_fn = vjp_fn
         self.parents = parents        # List[Optional[Tuple[Node, int]]]
         self.receivers = receivers    # List[Optional[NDArray]] (marked vars)
@@ -127,6 +128,7 @@ class Node:
         self.pure_fn = pure_fn        # primal jax fn (for create_graph)
         self.in_data = in_data        # input jax arrays at record time
         self.in_objs = in_objs        # original NDArray handles at record time
+        self.pure_tuple = pure_tuple  # pure_fn returns a tuple even for n=1
 
 
 def _zeros_like_aval(aval):
@@ -134,7 +136,8 @@ def _zeros_like_aval(aval):
 
 
 def record_op(vjp_fn, inputs: Sequence[Any], outputs: Sequence[Any],
-              name: str = "", pure_fn=None, in_data=None):
+              name: str = "", pure_fn=None, in_data=None,
+              pure_tuple: bool = False):
     """Attach a tape node to ``outputs`` (NDArrays) for op ``name``.
 
     ``inputs`` are the NDArray operands at dispatch time.
@@ -150,7 +153,8 @@ def record_op(vjp_fn, inputs: Sequence[Any], outputs: Sequence[Any],
     node = Node(vjp_fn, parents, receivers, len(outputs), out_avals, name,
                 pure_fn=pure_fn,
                 in_data=[x._data for x in inputs] if pure_fn is not None else None,
-                in_objs=list(inputs) if pure_fn is not None else None)
+                in_objs=list(inputs) if pure_fn is not None else None,
+                pure_tuple=pure_tuple)
     for i, o in enumerate(outputs):
         o._ag_node = node
         o._ag_out_idx = i
@@ -277,7 +281,7 @@ def _run_backward(heads, head_grads, variables=None, retain_graph=False,
 def _apply_vjp(node: Node, cts: List[Any], create_graph: bool) -> Tuple:
     """Run a node's vjp closure; optionally record it for higher-order grad."""
     vjp_fn = node.vjp_fn
-    arg = tuple(cts) if node.n_outputs > 1 else cts[0]
+    arg = tuple(cts) if (node.n_outputs > 1 or node.pure_tuple) else cts[0]
     if not create_graph:
         with _RecordingStateScope(False, None):
             return vjp_fn(arg)
@@ -309,18 +313,20 @@ def _apply_vjp(node: Node, cts: List[Any], create_graph: bool) -> Tuple:
 
         n_out, n_in = node.n_outputs, len(in_nds)
         pure = node.pure_fn
+        as_tuple = n_out > 1 or node.pure_tuple
 
         def bw(*arrays):
             cts_ = arrays[:n_out]
             prims = arrays[n_out:]
             _, inner = jax.vjp(pure, *prims)
-            return inner(tuple(cts_) if n_out > 1 else cts_[0])
+            return inner(tuple(cts_) if as_tuple else cts_[0])
 
         all_in = ct_nds + in_nds
         out_data, outer_vjp = jax.vjp(bw, *[a._data for a in all_in])
         out_nds = [NDArray(o) for o in out_data]
+        # bw returns a tuple of input cotangents even when there is one
         record_op(outer_vjp, all_in, out_nds,
-                  name=f"backward({node.name})", pure_fn=bw)
+                  name=f"backward({node.name})", pure_fn=bw, pure_tuple=True)
         return tuple(out_nds)
     with _RecordingStateScope(False, None):
         return vjp_fn(arg)
@@ -347,6 +353,7 @@ def _write_grad(var, g, written: set) -> None:
     else:
         var._grad._data = jnp.asarray(g, var._grad.dtype)
         written.add(buf_id)
+    var._grad_fresh = True  # Trainer stale-grad detection (reference parity)
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):

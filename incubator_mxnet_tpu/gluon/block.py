@@ -446,9 +446,16 @@ class CachedOp:
             return bwd_exec(list(res_flat), tuple(cts))
 
         tape_inputs = [p._data for p in params] + list(inputs)
+        # higher-order grad replays jax.vjp(pure_fn, *tape_inputs); bind this
+        # call's rng so pure's trailing-rng convention stays satisfied
+        pure = entry["pure"]
+
+        def pure_tape(*arrays):
+            return pure(*arrays, rng)
+
         autograd.record_op(vjp_closure, tape_inputs, all_nds,
                            name=f"CachedOp({block.name})",
-                           pure_fn=entry["pure"])
+                           pure_fn=pure_tape, pure_tuple=True)
         return out
 
     def _wrap_outputs(self, flat, holder, inputs, return_all=False):
@@ -522,19 +529,21 @@ class HybridBlock(Block):
     def __call__(self, *args):
         if self._active and self._cached_op is None:
             self._cached_op = CachedOp(self, **self._cached_op_args)
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = None
         if (self._active and _trace.stack == []
                 and all(isinstance(a, NDArray) for a in args)):
             try:
-                for hook in self._forward_pre_hooks:
-                    hook(self, args)
                 out = self._cached_op(*args)
-                for hook in self._forward_hooks:
-                    hook(self, args, out)
-                return out
             except DeferredInitializationError:
                 # first call resolves deferred shapes eagerly, then compiles
-                pass
-        return super().__call__(*args)
+                out = None
+        if out is None:
+            out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
 
     def forward(self, x, *args):
         from .. import ndarray as F

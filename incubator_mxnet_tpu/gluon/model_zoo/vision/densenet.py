@@ -1,0 +1,103 @@
+"""DenseNet 121/161/169/201 (reference ``model_zoo/vision/densenet.py``,
+Huang 1608.06993)."""
+
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Flatten,
+                   GlobalAvgPool2D, HybridSequential, MaxPool2D)
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = HybridSequential(prefix="")
+            self.body.add(BatchNorm())
+            self.body.add(Activation("relu"))
+            self.body.add(Conv2D(bn_size * growth_rate, 1, use_bias=False))
+            self.body.add(BatchNorm())
+            self.body.add(Activation("relu"))
+            self.body.add(Conv2D(growth_rate, 3, padding=1, use_bias=False))
+            if dropout:
+                from ...nn import Dropout
+
+                self.body.add(Dropout(dropout))
+
+    def forward(self, x, *args):
+        from .... import ndarray as F
+
+        return F.concat(x, self.body(x), axis=1)
+
+
+def _make_transition(num_output_features):
+    out = HybridSequential(prefix="")
+    out.add(BatchNorm())
+    out.add(Activation("relu"))
+    out.add(Conv2D(num_output_features, 1, use_bias=False))
+    out.add(AvgPool2D(2, 2))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = HybridSequential(prefix="")
+            self.features.add(Conv2D(num_init_features, 7, 2, 3,
+                                     use_bias=False))
+            self.features.add(BatchNorm())
+            self.features.add(Activation("relu"))
+            self.features.add(MaxPool2D(3, 2, 1))
+            num_features = num_init_features
+            for i, num_layers in enumerate(block_config):
+                block = HybridSequential(prefix=f"denseblock{i + 1}_")
+                with block.name_scope():
+                    for _ in range(num_layers):
+                        block.add(_DenseLayer(growth_rate, bn_size, dropout,
+                                              prefix=""))
+                self.features.add(block)
+                num_features += num_layers * growth_rate
+                if i != len(block_config) - 1:
+                    num_features //= 2
+                    self.features.add(_make_transition(num_features))
+            self.features.add(BatchNorm())
+            self.features.add(Activation("relu"))
+            self.features.add(GlobalAvgPool2D())
+            self.features.add(Flatten())
+            self.output = Dense(classes)
+
+    def forward(self, x, *args):
+        return self.output(self.features(x))
+
+
+densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
+                 161: (96, 48, [6, 12, 36, 24]),
+                 169: (64, 32, [6, 12, 32, 32]),
+                 201: (64, 32, [6, 12, 48, 32])}
+
+
+def _get_densenet(num_layers, pretrained=False, **kwargs):
+    kwargs.pop("ctx", None)
+    kwargs.pop("root", None)
+    if pretrained:
+        raise RuntimeError("no network egress; load weights manually")
+    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+
+
+def densenet121(**kw):
+    return _get_densenet(121, **kw)
+
+
+def densenet161(**kw):
+    return _get_densenet(161, **kw)
+
+
+def densenet169(**kw):
+    return _get_densenet(169, **kw)
+
+
+def densenet201(**kw):
+    return _get_densenet(201, **kw)

@@ -1,0 +1,6 @@
+"""Recurrent layers (reference ``python/mxnet/gluon/rnn/``)."""
+
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell, HybridRecurrentCell,
+                       LSTMCell, RNNCell, RecurrentCell, ResidualCell,
+                       SequentialRNNCell, ZoneoutCell)
+from .rnn_layer import GRU, LSTM, RNN

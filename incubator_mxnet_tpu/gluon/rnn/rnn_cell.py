@@ -1,0 +1,343 @@
+"""Unrolled RNN cells.
+
+Capability parity with reference ``python/mxnet/gluon/rnn/rnn_cell.py``:
+RNNCell, LSTMCell, GRUCell, SequentialRNNCell, DropoutCell, ZoneoutCell,
+ResidualCell, BidirectionalCell; ``begin_state`` / ``unroll``.
+
+Gate order matches the reference (LSTM: i f c o; GRU: r z n) so saved
+parameters interoperate with the fused layers.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (reference ``RecurrentCell.begin_state``)."""
+        from ... import ndarray as F
+
+        func = func or F.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell over ``length`` steps (reference ``unroll``)."""
+        from ... import ndarray as F
+
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            step = inputs.slice_axis(axis, t, t + 1).squeeze(axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def reset(self):
+        pass
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        from ... import ndarray as F
+
+        params = self._resolve_params(x)
+        i2h = F.FullyConnected(x, params["i2h_weight"], params["i2h_bias"],
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], params["h2h_weight"],
+                               params["h2h_bias"],
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        from ...ndarray import invoke, NDArray
+        import jax
+        import jax.numpy as jnp
+
+        params = self._resolve_params(x)
+        H = self._hidden_size
+
+        def fn(xd, h, c, wi, wh, bi, bh):
+            gates = xd @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = invoke(fn, [x, states[0], states[1], params["i2h_weight"],
+                             params["h2h_weight"], params["i2h_bias"],
+                             params["h2h_bias"]], name="lstm_cell")
+        return h2, [h2, c2]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        from ...ndarray import invoke
+        import jax
+        import jax.numpy as jnp
+
+        params = self._resolve_params(x)
+
+        def fn(xd, h, wi, wh, bi, bh):
+            gi = xd @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, inn = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(inn + r * hn)
+            return (1 - z) * n + z * h
+
+        h2 = invoke(fn, [x, states[0], params["i2h_weight"],
+                         params["h2h_weight"], params["i2h_bias"],
+                         params["h2h_bias"]], name="gru_cell")
+        return h2, [h2]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+        setattr(self, str(len(self._children) - 1), cell)
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size)
+                    for c in self._children.values()], [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum([c.begin_state(batch_size, **kwargs)
+                    for c in self._children.values()], [])
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, new_s = cell(x, states[p:p + n])
+            next_states.extend(new_s)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        from ... import ndarray as F
+
+        return F.Dropout(x, p=self._rate), states
+
+
+class ZoneoutCell(RecurrentCell):
+    """Zoneout regularization wrapper (reference ``ZoneoutCell``)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def forward(self, x, states):
+        from ... import ndarray as F
+        from ... import autograd
+
+        out, next_states = self.base_cell(x, states)
+        if autograd.is_training():
+            def mask(p, like):
+                return F.Dropout(F.ones_like(like), p=p)
+
+            if self._zo:
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(out)
+                m = mask(self._zo, out)
+                out = F.where(m, out, prev)
+            if self._zs:
+                next_states = [
+                    F.where(mask(self._zs, ns), ns, s)
+                    for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+    def reset(self):
+        self._prev_output = None
+        self.base_cell.reset()
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) \
+            + self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) \
+            + self.r_cell.begin_state(batch_size, **kwargs)
+
+    def __call__(self, *args):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (reference behavior)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        from ... import ndarray as F
+
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state or self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, states[:nl], layout, merge_outputs=True)
+        rev = F.flip(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, states[nl:], layout, merge_outputs=True)
+        r_out = F.flip(r_out, axis=axis)
+        out = F.concat(l_out, r_out, dim=2 if axis == 1 else 1)
+        return out, l_states + r_states

@@ -1,0 +1,233 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Capability parity with reference ``python/mxnet/gluon/rnn/rnn_layer.py`` over
+the fused RNN op (``src/operator/rnn.cc`` / cuDNN RNN): multi-layer,
+bidirectional, dropout between layers, TNC/NTC layouts, optional initial
+states.
+
+TPU-native redesign: the cuDNN fused kernel becomes ``jax.lax.scan`` over
+time — XLA compiles the whole sequence into one loop with on-chip state, and
+the per-step matmuls batch onto the MXU. The input projection (x @ Wᵀ) for
+ALL timesteps is hoisted out of the scan as one big matmul — the same trick
+cuDNN uses — leaving only the h2h recurrence inside the loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ndarray import NDArray, invoke
+
+
+def _cell_step(mode, gates_x, h, c, wh, bh):
+    """One recurrence step given precomputed input gates."""
+    if mode == "rnn_tanh":
+        h2 = jnp.tanh(gates_x + h @ wh.T + bh)
+        return h2, c
+    if mode == "rnn_relu":
+        h2 = jax.nn.relu(gates_x + h @ wh.T + bh)
+        return h2, c
+    if mode == "lstm":
+        gates = gates_x + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        return o * jnp.tanh(c2), c2
+    if mode == "gru":
+        gh = h @ wh.T + bh
+        ir, iz, inn = jnp.split(gates_x, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn)
+        return (1 - z) * n + z * h, c
+    raise ValueError(mode)
+
+
+def _run_direction(mode, x_tnc, h0, c0, wi, wh, bi, bh, reverse):
+    """Scan one direction of one layer. x_tnc: (T, N, I)."""
+    # hoist the input projection out of the loop: (T, N, G*H)
+    gates_x = jnp.einsum("tni,gi->tng", x_tnc, wi) + bi
+
+    def step(carry, gx):
+        h, c = carry
+        h2, c2 = _cell_step(mode, gx, h, c, wh, bh)
+        return (h2, c2), h2
+
+    (hT, cT), outs = lax.scan(step, (h0, c0), gates_x, reverse=reverse)
+    return outs, hT, cT
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        self._input_size = input_size
+        ng, h = self._gates, hidden_size
+        with self.name_scope():
+            for l in range(num_layers):
+                for d in (["l", "r"] if bidirectional else ["l"]):
+                    ins = input_size if l == 0 else h * self._dir
+                    for name, shape, init in (
+                            ("i2h_weight", (ng * h, ins),
+                             i2h_weight_initializer),
+                            ("h2h_weight", (ng * h, h),
+                             h2h_weight_initializer),
+                            ("i2h_bias", (ng * h,), i2h_bias_initializer),
+                            ("h2h_bias", (ng * h,), h2h_bias_initializer)):
+                        p = self.params.get(f"{d}{l}_{name}", shape=shape,
+                                            init=init,
+                                            allow_deferred_init=True)
+                        self._reg_params[f"{d}{l}_{name}"] = p
+                        setattr(self, f"{d}{l}_{name}", p)
+
+    def state_info(self, batch_size=0):
+        L = self._num_layers * self._dir
+        if self._mode == "lstm":
+            return [{"shape": (L, batch_size, self._hidden_size)},
+                    {"shape": (L, batch_size, self._hidden_size)}]
+        return [{"shape": (L, batch_size, self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+
+        func = func or F.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def infer_shape(self, x, *args):
+        ins = int(x.shape[2] if self._layout == "TNC" else x.shape[2])
+        h = self._hidden_size
+        for l in range(self._num_layers):
+            layer_in = ins if l == 0 else h * self._dir
+            for d in (["l", "r"] if self._dir == 2 else ["l"]):
+                self._reg_params[f"{d}{l}_i2h_weight"].shape = \
+                    (self._gates * h, layer_in)
+
+    def forward(self, x, states=None):
+        from ... import autograd
+
+        params = self._resolve_params(x)
+        mode = self._mode
+        L, D, H = self._num_layers, self._dir, self._hidden_size
+        layout = self._layout
+        dropout = self._dropout if autograd.is_training() else 0.0
+        lstm = mode == "lstm"
+
+        state_nds: List[NDArray] = []
+        explicit_states = states is not None
+        if explicit_states:
+            if isinstance(states, NDArray):
+                states = [states]
+            state_nds = list(states)
+
+        pnames = []
+        for l in range(L):
+            for d in (["l", "r"] if D == 2 else ["l"]):
+                pnames += [f"{d}{l}_i2h_weight", f"{d}{l}_h2h_weight",
+                           f"{d}{l}_i2h_bias", f"{d}{l}_h2h_bias"]
+        parrays = [params[n] for n in pnames]
+
+        def fn(xd, *rest, rng=None):
+            n_states = len(state_nds)
+            st = rest[:n_states]
+            ws = rest[n_states:]
+            if layout == "NTC":
+                xd = jnp.swapaxes(xd, 0, 1)  # -> TNC
+            T, N = xd.shape[0], xd.shape[1]
+            if n_states:
+                h0_all = st[0]
+                c0_all = st[1] if lstm else None
+            else:
+                h0_all = jnp.zeros((L * D, N, H), xd.dtype)
+                c0_all = jnp.zeros((L * D, N, H), xd.dtype) if lstm else None
+            hTs, cTs = [], []
+            inp = xd
+            k = 0
+            for l in range(L):
+                outs_dir = []
+                for di in range(D):
+                    wi, wh, bi, bh = ws[k:k + 4]
+                    k += 4
+                    idx = l * D + di
+                    h0 = h0_all[idx]
+                    c0 = c0_all[idx] if lstm else jnp.zeros_like(h0)
+                    outs, hT, cT = _run_direction(
+                        mode, inp, h0, c0, wi, wh, bi, bh, reverse=di == 1)
+                    outs_dir.append(outs)
+                    hTs.append(hT)
+                    cTs.append(cT)
+                inp = outs_dir[0] if D == 1 else jnp.concatenate(
+                    outs_dir, axis=-1)
+                if dropout and l != L - 1:
+                    keep = 1.0 - dropout
+                    mask = jax.random.bernoulli(
+                        jax.random.fold_in(rng, l), keep,
+                        inp.shape).astype(inp.dtype)
+                    inp = inp * mask / keep
+            out = inp if layout == "TNC" else jnp.swapaxes(inp, 0, 1)
+            hN = jnp.stack(hTs, axis=0)
+            if lstm:
+                return out, hN, jnp.stack(cTs, axis=0)
+            return out, hN
+
+        needs_rng = bool(dropout)
+        result = invoke(fn, [x] + state_nds + parrays, name=f"fused_{mode}",
+                        needs_rng=needs_rng)
+        if lstm:
+            out, hN, cN = result
+            return (out, [hN, cN]) if explicit_states else out
+        out, hN = result
+        return (out, [hN]) if explicit_states else out
+
+    def __call__(self, x, states=None):
+        if states is None:
+            return super().__call__(x)
+        return super().__call__(x, states)
+
+
+class RNN(_RNNLayer):
+    """Elman RNN (reference ``gluon.rnn.RNN``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="tanh",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Fused LSTM (reference ``gluon.rnn.LSTM`` — the PTB north-star layer,
+    BASELINE.json config[3])."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

@@ -124,6 +124,18 @@ class Trainer:
                 continue
             if p._data._grad is None:
                 continue
+            if not p._data._grad_fresh:
+                # gradient not touched by backward since the last step
+                if ignore_stale_grad:
+                    continue
+                raise UserWarning(
+                    f"Gradient of Parameter `{p.name}` has not been updated "
+                    "by backward since last `step`. This could mean a bug in "
+                    "your model that made it only use a subset of the "
+                    "Parameters for this iteration. If you are intentionally "
+                    "only using a subset, call step with "
+                    "ignore_stale_grad=True (reference Trainer semantics)")
+            p._data._grad_fresh = False
             if self._kvstore is not None and self._update_on_kvstore:
                 self._kvstore.push(i, p.grad())
                 self._kvstore.pull(i, out=p.data())

@@ -139,14 +139,27 @@ class TopKAccuracy(EvalMetric):
 
 @register
 class F1(EvalMetric):
+    """Binary F1. ``average='macro'`` averages per-update F1 scores;
+    ``'micro'`` pools global tp/fp/fn counts (reference semantics)."""
+
     def __init__(self, name="f1", average="macro", **kwargs):
         super().__init__(name, **kwargs)
         self.average = average
         self._tp = self._fp = self._fn = 0.0
+        self._macro_sum = 0.0
+        self._macro_n = 0
 
     def reset(self):
         super().reset()
         self._tp = self._fp = self._fn = 0.0
+        self._macro_sum = 0.0
+        self._macro_n = 0
+
+    @staticmethod
+    def _f1(tp, fp, fn):
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)
@@ -156,16 +169,22 @@ class F1(EvalMetric):
             if p.ndim > 1:
                 p = _numpy.argmax(p, axis=-1)
             p = p.ravel()
-            self._tp += float(((p == 1) & (l == 1)).sum())
-            self._fp += float(((p == 1) & (l == 0)).sum())
-            self._fn += float(((p == 0) & (l == 1)).sum())
+            tp = float(((p == 1) & (l == 1)).sum())
+            fp = float(((p == 1) & (l == 0)).sum())
+            fn = float(((p == 0) & (l == 1)).sum())
+            self._tp += tp
+            self._fp += fp
+            self._fn += fn
+            self._macro_sum += self._f1(tp, fp, fn)
+            self._macro_n += 1
             self.num_inst += len(l)
 
     def get(self):
-        prec = self._tp / max(self._tp + self._fp, 1e-12)
-        rec = self._tp / max(self._tp + self._fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return (self.name, f1 if self.num_inst else float("nan"))
+        if not self.num_inst:
+            return (self.name, float("nan"))
+        if self.average == "macro":
+            return (self.name, self._macro_sum / max(self._macro_n, 1))
+        return (self.name, self._f1(self._tp, self._fp, self._fn))
 
 
 @register

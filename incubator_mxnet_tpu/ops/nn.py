@@ -30,7 +30,10 @@ def _pair(v):
 def _ntuple(v, n):
     if isinstance(v, (tuple, list)):
         t = tuple(int(x) for x in v)
-        return t if len(t) == n else (t * n)[:n]
+        if len(t) != n:
+            raise ValueError(
+                f"expected a scalar or length-{n} tuple, got {v!r}")
+        return t
     return (int(v),) * n
 
 

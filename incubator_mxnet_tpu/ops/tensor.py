@@ -293,8 +293,9 @@ def space_to_depth(x, block_size=2):
 
 # -- joining / splitting -----------------------------------------------------
 @register("concat", aliases=("concatenate",))
-def concat(*arrays, dim=1):
-    return jnp.concatenate(arrays, axis=dim)
+def concat(*arrays, dim=1, axis=None):
+    # reference 1.x spells it `dim`; np-world spells it `axis`
+    return jnp.concatenate(arrays, axis=dim if axis is None else axis)
 
 
 @register("stack")

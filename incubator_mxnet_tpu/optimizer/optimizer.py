@@ -365,12 +365,14 @@ class AdaGrad(Optimizer):
             self.clip_gradient
 
         def fn(w, g, h, lr, wd):
+            # reference AdaGrad: history accumulates the raw (rescaled,
+            # clipped) grad; wd applies at update time; eps inside the sqrt
             g = g.astype(w.dtype) * rescale
             if clip is not None:
                 g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
             h = h + jnp.square(g)
-            w = w - lr.astype(w.dtype) * g / (jnp.sqrt(h) + eps)
+            div = g / jnp.sqrt(h + eps)
+            w = w - lr.astype(w.dtype) * (div + wd.astype(w.dtype) * w)
             return w, (h,)
 
         (new_h,) = self._run("adagrad", fn, weight, grad._data, (state,),
@@ -670,10 +672,12 @@ class SGLD(Optimizer):
                 * jnp.sqrt(lr).astype(w.dtype)
             return w - 0.5 * lr_t * g + noise, ()
 
-        jfn = self._jit_cache.get(("sgld", weight.shape, str(weight.dtype)))
+        cache_key = ("sgld", weight.shape, str(weight.dtype),
+                     float(self.rescale_grad), self.clip_gradient)
+        jfn = self._jit_cache.get(cache_key)
         if jfn is None:
             jfn = jax.jit(fn)
-            self._jit_cache[("sgld", weight.shape, str(weight.dtype))] = jfn
+            self._jit_cache[cache_key] = jfn
         new_w, _ = jfn(weight._data, grad._data, key,
                        jnp.asarray(lr, jnp.float32),
                        jnp.asarray(wd, jnp.float32))

@@ -1,0 +1,167 @@
+"""Model zoo + RNN tests (reference tests/python/unittest/test_gluon_model_zoo.py
+and test_gluon_rnn.py patterns)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn, rnn
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 224), ("resnet18_v2", 224), ("squeezenet1.1", 224),
+    ("mobilenet0.25", 224), ("mobilenetv2_0.25", 224),
+])
+def test_model_zoo_forward(name, size):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    out = net(mx.nd.uniform(shape=(2, 3, size, size)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_parameter_count():
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    net(mx.nd.uniform(shape=(1, 3, 224, 224)))
+    n = sum(int(np.prod(p.shape)) for p in net.collect_params().values())
+    assert abs(n - 25.6e6) < 0.5e6, f"resnet50 params {n}"
+
+
+def test_model_zoo_unknown_name():
+    with pytest.raises(ValueError, match="not found"):
+        vision.get_model("resnet9000")
+
+
+def test_resnet_train_step():
+    net = vision.get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.uniform(shape=(4, 3, 32, 32))
+    y = mx.nd.array(np.array([0, 1, 2, 3]))
+    for _ in range(2):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(4)
+    assert np.isfinite(l.asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# RNN
+# ---------------------------------------------------------------------------
+def test_lstm_fused_matches_cell_unroll():
+    mx.random.seed(0)
+    l1 = rnn.LSTM(8, layout='NTC', input_size=5)
+    l1.initialize()
+    cell = rnn.LSTMCell(8, input_size=5)
+    cell.initialize()
+    cp = l1.collect_params()
+    pre = l1.prefix
+    cell.i2h_weight.set_data(cp[pre + 'l0_i2h_weight'].data())
+    cell.h2h_weight.set_data(cp[pre + 'l0_h2h_weight'].data())
+    cell.i2h_bias.set_data(cp[pre + 'l0_i2h_bias'].data())
+    cell.h2h_bias.set_data(cp[pre + 'l0_h2h_bias'].data())
+    x = mx.nd.uniform(shape=(3, 7, 5))
+    fused = l1(x).asnumpy()
+    unrolled, _ = cell.unroll(7, x, layout='NTC')
+    np.testing.assert_allclose(fused, unrolled.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gru_fused_matches_cell_unroll():
+    mx.random.seed(1)
+    l1 = rnn.GRU(6, layout='NTC', input_size=4)
+    l1.initialize()
+    cell = rnn.GRUCell(6, input_size=4)
+    cell.initialize()
+    cp = l1.collect_params()
+    pre = l1.prefix
+    cell.i2h_weight.set_data(cp[pre + 'l0_i2h_weight'].data())
+    cell.h2h_weight.set_data(cp[pre + 'l0_h2h_weight'].data())
+    cell.i2h_bias.set_data(cp[pre + 'l0_i2h_bias'].data())
+    cell.h2h_bias.set_data(cp[pre + 'l0_h2h_bias'].data())
+    x = mx.nd.uniform(shape=(2, 5, 4))
+    np.testing.assert_allclose(l1(x).asnumpy(),
+                               cell.unroll(5, x, layout='NTC')[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_bidirectional_shapes():
+    net = rnn.LSTM(16, num_layers=2, bidirectional=True, layout='NTC')
+    net.initialize()
+    x = mx.nd.uniform(shape=(4, 10, 8))
+    out, states = net(x, net.begin_state(4))
+    assert out.shape == (4, 10, 32)
+    assert states[0].shape == (4, 4, 16)  # layers*dirs, batch, hidden
+    assert states[1].shape == (4, 4, 16)
+
+
+def test_lstm_gradient_flows():
+    net = rnn.LSTM(8, num_layers=2, dropout=0.2)
+    net.initialize()
+    x = mx.nd.uniform(shape=(6, 3, 4))  # TNC
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for name, p in net.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all(), name
+
+
+def test_rnn_cells_and_wrappers():
+    cell = rnn.SequentialRNNCell()
+    cell.add(rnn.LSTMCell(8, input_size=4))
+    cell.add(rnn.DropoutCell(0.1))
+    cell.add(rnn.ResidualCell(rnn.GRUCell(8, input_size=8)))
+    cell.initialize()
+    x = mx.nd.uniform(shape=(2, 4))
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 8)
+    assert len(new_states) == len(states)
+
+
+def test_bidirectional_cell_unroll():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                               rnn.LSTMCell(4, input_size=3))
+    bi.initialize()
+    x = mx.nd.uniform(shape=(2, 5, 3))
+    out, states = bi.unroll(5, x, layout='NTC')
+    assert out.shape == (2, 5, 8)
+
+
+def test_lstm_language_model_converges():
+    """Tiny PTB-style LM slice (BASELINE config[3] shape)."""
+    np.random.seed(0)
+    V, E, H, T, B = 20, 16, 32, 8, 16
+
+    class LM(nn.HybridSequential):
+        pass
+
+    embed = nn.Embedding(V, E)
+    lstm = rnn.LSTM(H, layout='NTC', input_size=E)
+    dense = nn.Dense(V, flatten=False, in_units=H)
+    net = nn.HybridSequential()
+    net.add(embed, lstm, dense)
+    net.initialize(init='xavier')
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    data = np.random.randint(0, V, (B, T + 1))
+    x = mx.nd.array(data[:, :-1], dtype='int32')
+    y = mx.nd.array(data[:, 1:])
+    first = None
+    for i in range(30):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(B)
+        if first is None:
+            first = float(l.mean().asscalar())
+    last = float(l.mean().asscalar())
+    assert last < first
