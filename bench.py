@@ -57,18 +57,19 @@ def main():
     x = mx.nd.NDArray(x)
     y = mx.nd.NDArray(y)
 
-    # warmup: compile + 2 steps
+    # warmup: compile + 2 steps (device_get forces a full roundtrip — the
+    # experimental PJRT tunnel's block_until_ready is not a reliable fence)
     loss = trainer.step(x, y)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     for _ in range(2):
         loss = trainer.step(x, y)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
 
     iters = 10
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = trainer.step(x, y)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters / dt

@@ -1,0 +1,6 @@
+"""AMP: automatic mixed precision (reference ``python/mxnet/amp/``)."""
+
+from .amp import (convert_model, deinit, init, init_trainer, scale_loss,
+                  unscale)
+from .loss_scaler import LossScaler
+from . import lists

@@ -1,0 +1,131 @@
+"""AMP core.
+
+Capability parity with reference ``python/mxnet/amp/amp.py``: ``init()``
+installs a mixed-precision cast policy over the op namespace, ``init_trainer``
++ ``scale_loss`` add dynamic loss scaling with overflow-skip,
+``convert_model`` casts a model for low-precision inference.
+
+TPU-native redesign: the reference monkey-patches every generated op wrapper
+to insert ``amp_cast`` symbols. Here the imperative dispatcher (``invoke``)
+consults one policy object by op name — same three op classes, one choke
+point, and XLA fuses the inserted ``convert_element_type`` into the
+consuming kernel so casts are free. Default target dtype is **bfloat16**
+(MXU-native; fp16 supported for parity).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..base import resolve_dtype
+from ..ndarray import ndarray as _ndimpl
+from . import lists
+from .loss_scaler import LossScaler
+
+
+class AmpPolicy:
+    def __init__(self, target_dtype="bfloat16",
+                 target_dtype_ops=None, fp32_ops=None, widest_ops=None):
+        self.target_dtype = resolve_dtype(target_dtype)
+        self.target_ops = set(target_dtype_ops
+                              if target_dtype_ops is not None
+                              else lists.TARGET_DTYPE_OPS)
+        self.fp32_ops = set(fp32_ops if fp32_ops is not None
+                            else lists.FP32_OPS)
+        self.widest_ops = set(widest_ops if widest_ops is not None
+                              else lists.WIDEST_TYPE_CASTS)
+
+    def apply(self, name: str, in_data):
+        def is_float(a):
+            return jnp.issubdtype(a.dtype, jnp.floating)
+
+        if name in self.target_ops:
+            return [jnp.asarray(a, self.target_dtype) if is_float(a) else a
+                    for a in in_data]
+        if name in self.fp32_ops:
+            return [jnp.asarray(a, jnp.float32) if is_float(a) else a
+                    for a in in_data]
+        if name in self.widest_ops:
+            floats = [a.dtype for a in in_data if is_float(a)]
+            if len(set(floats)) > 1:
+                widest = jnp.promote_types(*floats) if len(floats) == 2 \
+                    else jnp.result_type(*floats)
+                return [jnp.asarray(a, widest) if is_float(a) else a
+                        for a in in_data]
+        return in_data
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None, layout_optimization=False):
+    """Enable AMP globally (reference ``amp.init``)."""
+    policy = AmpPolicy(target_dtype, target_precision_ops, fp32_ops)
+    _ndimpl.set_amp_policy(policy)
+    return policy
+
+
+def deinit():
+    _ndimpl.set_amp_policy(None)
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a model for low-precision inference (reference
+    ``amp.convert_model``). BatchNorm statistics stay fp32-safe because the
+    kernel upcasts internally."""
+    net.cast(target_dtype)
+    return net
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Trainer (reference
+    ``amp.init_trainer``): step() then checks overflow, skips the update on
+    inf/nan grads, and adapts the scale."""
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    orig_update = trainer._update
+
+    def _amp_update(ignore_stale_grad=False):
+        overflow = scaler.has_overflow(trainer._params)
+        scaler.update_scale(overflow)
+        if overflow:
+            # skip the update; mark grads consumed so the next step
+            # doesn't trip the stale-grad check
+            for p in trainer._params:
+                if p._data is not None and p._data._grad is not None:
+                    p._data._grad_fresh = False
+            return
+        orig_update(ignore_stale_grad)
+
+    trainer._update = _amp_update
+    return scaler
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as l: l.backward()`` —
+    multiplies the loss by the current scale; the trainer divides grads
+    back via rescale_grad."""
+    scaler: Optional[LossScaler] = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    trainer._scale = 1.0 / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Explicitly unscale gradients (for grad clipping before step)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p._data is not None and p._data._grad is not None:
+            g = p._data._grad
+            g._data = g._data * inv
+    trainer._scale = 1.0

@@ -1,0 +1,29 @@
+"""AMP op lists (reference ``python/mxnet/amp/lists/symbol_fp16.py``).
+
+Three classes, reference semantics:
+- ``TARGET_DTYPE_OPS``: run in the low-precision dtype (MXU ops);
+- ``FP32_OPS``: always fp32 (numerically sensitive);
+- ``WIDEST_TYPE_CASTS``: run in the widest dtype among inputs.
+
+On TPU the low-precision target is bfloat16 (same exponent range as fp32),
+so the reference's fp16 overflow machinery (loss scaling) is optional; it is
+kept for fp16 compatibility.
+"""
+
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "matmul", "scaled_dot_product_attention", "linalg_gemm2",
+]
+
+FP32_OPS = [
+    "softmax", "log_softmax", "softmax_cross_entropy", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "RMSNorm",
+    "L2Normalization", "norm", "mean", "sum", "exp", "log", "erf",
+    "erfinv", "logsumexp", "cumsum",
+]
+
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "divide", "broadcast_add",
+    "broadcast_sub", "broadcast_mul", "broadcast_div", "concat", "stack",
+    "where", "maximum", "minimum",
+]

@@ -1,0 +1,40 @@
+"""Dynamic loss scaler (reference ``python/mxnet/amp/loss_scaler.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LossScaler:
+    """Dynamic scaling: double every ``scale_window`` clean steps, halve on
+    overflow (reference semantics). With bf16 on TPU overflow is rare; the
+    scaler then sits at its cap harmlessly."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._max_scale = 2.0 ** 24
+
+    def has_overflow(self, params) -> bool:
+        """Check grads for inf/nan (the reference's multi_all_finite op)."""
+        for p in params:
+            if p._data is None or p._data._grad is None:
+                continue
+            g = p._data._grad.asnumpy()
+            if not np.isfinite(g).all():
+                return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      self._max_scale)
+                self._unskipped = 0

@@ -1,0 +1,8 @@
+"""Gluon data API (reference ``python/mxnet/gluon/data/``)."""
+
+from . import vision
+from .dataloader import DataLoader
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset,
+                      SimpleDataset)
+from .sampler import (BatchSampler, RandomSampler, Sampler,
+                      SequentialSampler)

@@ -1,0 +1,216 @@
+"""Vision datasets.
+
+Capability parity with reference ``gluon/data/vision/datasets.py``: MNIST,
+FashionMNIST, CIFAR10/100, ImageRecordDataset, ImageFolderDataset.
+
+No network egress in this environment: datasets read standard local files
+(MNIST idx files, CIFAR pickles) from ``root`` when present; otherwise they
+raise with download instructions. ``synthetic=True`` yields a deterministic
+fake dataset of the right shapes for pipelines/tests.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ..dataset import Dataset
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _synthetic(shape, classes, n=1000, seed=0):
+    rng = np.random.RandomState(seed)
+    data = (rng.rand(n, *shape) * 255).astype(np.uint8)
+    label = rng.randint(0, classes, n).astype(np.int32)
+    return data, label
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference ``vision.MNIST``); items are (HWC uint8, int32)."""
+
+    _files = {True: ("train-images-idx3-ubyte.gz",
+                     "train-labels-idx1-ubyte.gz"),
+              False: ("t10k-images-idx3-ubyte.gz",
+                      "t10k-labels-idx1-ubyte.gz")}
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None, synthetic=False):
+        self._synthetic = synthetic
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        if self._synthetic:
+            self._data, self._label = _synthetic(self._shape, self._classes)
+            return
+        img_f, lbl_f = self._files[self._train]
+        img_p = os.path.join(self._root, img_f)
+        lbl_p = os.path.join(self._root, lbl_f)
+        for p in (img_p, lbl_p):
+            if not os.path.exists(p) and not os.path.exists(p[:-3]):
+                raise RuntimeError(
+                    f"{p} not found and no network egress; place the MNIST "
+                    f"idx files under {self._root} or use synthetic=True")
+
+        def _open(p):
+            if os.path.exists(p):
+                return gzip.open(p, "rb")
+            return open(p[:-3], "rb")
+
+        with _open(lbl_p) as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            self._label = np.frombuffer(f.read(), dtype=np.uint8) \
+                .astype(np.int32)
+        with _open(img_p) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            self._data = np.frombuffer(f.read(), dtype=np.uint8) \
+                .reshape(num, rows, cols, 1)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None, synthetic=False):
+        super().__init__(root, train, transform, synthetic)
+
+
+class CIFAR10(_DownloadedDataset):
+    _shape = (32, 32, 3)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None, synthetic=False):
+        self._synthetic = synthetic
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        if self._synthetic:
+            self._data, self._label = _synthetic(self._shape, self._classes)
+            return
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        files = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        data, labels = [], []
+        for fname in files:
+            p = os.path.join(base, fname)
+            if not os.path.exists(p):
+                raise RuntimeError(
+                    f"{p} not found and no network egress; extract the "
+                    f"CIFAR-10 python archive under {base} or use "
+                    "synthetic=True")
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32)
+                        .transpose(0, 2, 3, 1))
+            labels.extend(d[b"labels"])
+        self._data = np.concatenate(data)
+        self._label = np.asarray(labels, np.int32)
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 transform=None, fine_label=True, synthetic=False):
+        self._fine = fine_label
+        super().__init__(root, train, transform, synthetic)
+
+    def _get_data(self):
+        if self._synthetic:
+            self._data, self._label = _synthetic(self._shape, self._classes)
+            return
+        base = os.path.join(self._root, "cifar-100-python")
+        fname = "train" if self._train else "test"
+        p = os.path.join(base, fname)
+        if not os.path.exists(p):
+            raise RuntimeError(f"{p} not found; no network egress")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self._data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = np.asarray(d[key], np.int32)
+
+
+class ImageRecordDataset(Dataset):
+    """Images from a RecordIO pack (reference ``ImageRecordDataset``)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ....recordio import IndexedRecordIO, unpack_img
+
+        self._record = IndexedRecordIO(
+            filename.rsplit(".", 1)[0] + ".idx", filename, "r")
+        self._flag = flag
+        self._transform = transform
+        self._unpack = unpack_img
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        record = self._record.read_idx(self._record.keys[idx])
+        header, img = self._unpack(record, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """class-per-subfolder image tree (reference ``ImageFolderDataset``)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp", ".npy"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            img = imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

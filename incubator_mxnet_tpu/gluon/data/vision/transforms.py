@@ -1,0 +1,279 @@
+"""Image transforms.
+
+Capability parity with reference ``gluon/data/vision/transforms.py``:
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomCrop, RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/
+Saturation/Hue/ColorJitter, RandomLighting.
+
+Host-side numpy implementations (the loader runs on host; PJRT overlaps the
+H2D copy) — matching the reference where augmentation is CPU-side OpenCV.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import Block
+from ....ndarray import NDArray, array as nd_array
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+class Compose(Block):
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x) if not isinstance(t, Block) else t(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return nd_array(_as_np(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ``ToTensor``)."""
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return nd_array(a)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((a - mean) / std)
+
+
+def _resize_np(a, size, interp=1):
+    """Nearest/bilinear resize without OpenCV (HWC)."""
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        # shorter side to `size`, keep aspect (reference Resize(int))
+        if h < w:
+            nh, nw = size, max(1, int(round(w * size / h)))
+        else:
+            nh, nw = max(1, int(round(h * size / w))), size
+    else:
+        nw, nh = size  # reference passes (w, h)
+    if (nh, nw) == (h, w):
+        return a
+    ys = np.linspace(0, h - 1, nh)
+    xs = np.linspace(0, w - 1, nw)
+    if interp == 0:  # nearest
+        return a[np.round(ys).astype(int)[:, None],
+                 np.round(xs).astype(int)[None, :]]
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    a = a.astype(np.float32)
+    out = (a[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+           + a[y1[:, None], x0[None, :]] * wy * (1 - wx)
+           + a[y0[:, None], x1[None, :]] * (1 - wy) * wx
+           + a[y1[:, None], x1[None, :]] * wy * wx)
+    return out
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, x):
+        return nd_array(_resize_np(_as_np(x), self._size, self._interp))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        a = _as_np(x)
+        w, h = self._size
+        H, W = a.shape[:2]
+        if H < h or W < w:
+            a = _resize_np(a, (max(w, W), max(h, H)))
+            H, W = a.shape[:2]
+        y0 = (H - h) // 2
+        x0 = (W - w) // 2
+        return nd_array(a[y0:y0 + h, x0:x0 + w])
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        a = _as_np(x)
+        if self._pad:
+            p = self._pad
+            a = np.pad(a, ((p, p), (p, p), (0, 0)))
+        w, h = self._size
+        H, W = a.shape[:2]
+        y0 = np.random.randint(0, max(H - h, 0) + 1)
+        x0 = np.random.randint(0, max(W - w, 0) + 1)
+        return nd_array(a[y0:y0 + h, x0:x0 + w])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        a = _as_np(x)
+        H, W = a.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self._scale)
+            ar = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = a[y0:y0 + h, x0:x0 + w]
+                return nd_array(_resize_np(crop, self._size))
+        return CenterCrop(self._size)(nd_array(a))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        a = _as_np(x)
+        if np.random.rand() < 0.5:
+            a = a[:, ::-1]
+        return nd_array(np.ascontiguousarray(a))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        a = _as_np(x)
+        if np.random.rand() < 0.5:
+            a = a[::-1]
+        return nd_array(np.ascontiguousarray(a))
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._b, self._b)
+        return nd_array(a * f)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._c, self._c)
+        gray = a.mean()
+        return nd_array(gray + (a - gray) * f)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._s, self._s)
+        gray = a.mean(axis=-1, keepdims=True)
+        return nd_array(gray + (a - gray) * f)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        # lightweight approximation: channel rotation in YIQ space
+        a = _as_np(x).astype(np.float32)
+        alpha = np.random.uniform(-self._h, self._h) * np.pi
+        u, w = np.cos(alpha), np.sin(alpha)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.linalg.inv(t_yiq)
+        rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return nd_array(a @ m.T)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference ``RandomLighting``)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.814],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        rgb = (self._eigvec * alpha) @ self._eigval
+        return nd_array(a + rgb)

@@ -339,5 +339,6 @@ class BidirectionalCell(RecurrentCell):
         r_out, r_states = self.r_cell.unroll(
             length, rev, states[nl:], layout, merge_outputs=True)
         r_out = F.flip(r_out, axis=axis)
-        out = F.concat(l_out, r_out, dim=2 if axis == 1 else 1)
+        # features are axis 2 in both TNC and NTC merged outputs
+        out = F.concat(l_out, r_out, dim=2)
         return out, l_states + r_states

@@ -115,7 +115,7 @@ class _RNNLayer(HybridBlock):
                 for info in self.state_info(batch_size)]
 
     def infer_shape(self, x, *args):
-        ins = int(x.shape[2] if self._layout == "TNC" else x.shape[2])
+        ins = int(x.shape[2])  # features are axis 2 in both TNC and NTC
         h = self._hidden_size
         for l in range(self._num_layers):
             layer_in = ins if l == 0 else h * self._dir

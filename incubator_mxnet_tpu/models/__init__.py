@@ -1,0 +1,8 @@
+"""Model families beyond the vision zoo (BERT, transformer blocks, SSD).
+
+The reference ecosystem keeps these in GluonNLP/GluonCV; they are part of
+this framework's capability surface (BASELINE.json configs 2 and 4).
+"""
+
+from .transformer import (BERTEncoder, BERTModel, MultiHeadAttention,
+                          PositionwiseFFN, TransformerEncoderCell, get_bert)

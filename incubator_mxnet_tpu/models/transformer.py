@@ -1,0 +1,197 @@
+"""Transformer encoder / BERT.
+
+Capability parity target: GluonNLP's BERT-base (BASELINE.json config[2] —
+the reference stack builds attention from Dense/batch_dot; SURVEY.md §5
+"Long-context"). TPU-native: attention runs through the
+``scaled_dot_product_attention`` op (XLA-fused; Pallas flash / ring variants
+pluggable via ``attention_impl``), everything hybridizable, and the layout
+keeps (B, T, C) activations so the ``seq`` mesh axis can shard T for
+sequence parallelism (parallel/ring_attention).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross attention (B, T, C) with ``num_heads`` (GluonNLP
+    ``MultiHeadAttentionCell`` capability)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 attention_impl="xla", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        self._impl = attention_impl
+        with self.name_scope():
+            self.query = Dense(units, flatten=False, use_bias=use_bias,
+                               in_units=units)
+            self.key = Dense(units, flatten=False, use_bias=use_bias,
+                             in_units=units)
+            self.value = Dense(units, flatten=False, use_bias=use_bias,
+                               in_units=units)
+            self.proj = Dense(units, flatten=False, use_bias=use_bias,
+                              in_units=units)
+            self.attn_dropout = Dropout(dropout)
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self._heads,
+                         self._units // self._heads).transpose(
+                             (0, 2, 1, 3))
+
+    def forward(self, x, mask=None):
+        from .. import ndarray as F
+
+        q = self._split(self.query(x))
+        k = self._split(self.key(x))
+        v = self._split(self.value(x))
+        if self._impl == "ring":
+            from ..parallel.ring_attention import ring_attention_nd
+
+            out = ring_attention_nd(q, k, v, mask=mask)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, mask=mask)
+        b, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape(b, t, self._units)
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ffn1 = Dense(hidden_size, flatten=False, in_units=units,
+                              activation=None)
+            self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
+            self.dropout = Dropout(dropout)
+        self._act = activation
+
+    def forward(self, x):
+        from .. import ndarray as F
+
+        h = F.Activation(self.ffn1(x), act_type=self._act)
+        return self.dropout(self.ffn2(h))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-norm encoder layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 attention_impl="xla", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout,
+                                                attention_impl=attention_impl)
+            self.dropout = Dropout(dropout)
+            self.ln1 = LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+            self.ln2 = LayerNorm(in_channels=units)
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x + self.dropout(self.attention(x, mask)))
+        return self.ln2(h + self.ffn(h))
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.1, attention_impl="xla", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            for i in range(num_layers):
+                setattr(self, f"layer{i}",
+                        TransformerEncoderCell(units, hidden_size, num_heads,
+                                               dropout, attention_impl))
+        self._num_layers = num_layers
+
+    def forward(self, x, mask=None):
+        for i in range(self._num_layers):
+            x = getattr(self, f"layer{i}")(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT with MLM + NSP heads (GluonNLP ``BERTModel`` capability).
+
+    forward(token_ids, segment_ids, valid_length) ->
+        (sequence_output, pooled_output, mlm_scores)
+    """
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, attention_impl="xla",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units)
+            self.token_type_embed = Embedding(type_vocab_size, units)
+            self.position_embed = Embedding(max_length, units)
+            self.embed_ln = LayerNorm(in_channels=units)
+            self.embed_dropout = Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout, attention_impl)
+            self.pooler = Dense(units, in_units=units, activation="tanh")
+            self.nsp_classifier = Dense(2, in_units=units)
+            self.mlm_decoder = HybridSequential(prefix="mlm_")
+            with self.mlm_decoder.name_scope():
+                self.mlm_decoder.add(
+                    Dense(units, flatten=False, in_units=units,
+                          activation="gelu"),
+                    LayerNorm(in_channels=units),
+                    Dense(vocab_size, flatten=False, in_units=units))
+
+    def forward(self, token_ids, segment_ids=None, valid_length=None):
+        from .. import ndarray as F
+        from ..ndarray import invoke
+        import jax.numpy as jnp
+
+        b, t = token_ids.shape
+        pos = invoke(lambda x: jnp.broadcast_to(
+            jnp.arange(x.shape[1], dtype=jnp.int32), x.shape),
+            [token_ids], name="positions", differentiable=False)
+        emb = self.word_embed(token_ids) + self.position_embed(pos)
+        if segment_ids is not None:
+            emb = emb + self.token_type_embed(segment_ids)
+        emb = self.embed_dropout(self.embed_ln(emb))
+
+        mask = None
+        if valid_length is not None:
+            mask = invoke(
+                lambda vl: (jnp.arange(t)[None, None, None, :]
+                            < vl.reshape(-1, 1, 1, 1)).astype(jnp.float32),
+                [valid_length], name="attn_mask", differentiable=False)
+        seq = self.encoder(emb, mask)
+        pooled = self.pooler(seq.slice_axis(1, 0, 1).squeeze(1))
+        mlm = self.mlm_decoder(seq)
+        return seq, pooled, mlm
+
+
+_BERT_SPECS = {
+    "bert_12_768_12": dict(num_layers=12, units=768, hidden_size=3072,
+                           num_heads=12),
+    "bert_24_1024_16": dict(num_layers=24, units=1024, hidden_size=4096,
+                            num_heads=16),
+}
+
+
+def get_bert(model_name="bert_12_768_12", vocab_size=30522, dropout=0.1,
+             max_length=512, attention_impl="xla", **kwargs):
+    """BERT factory (GluonNLP ``get_model('bert_12_768_12')`` capability)."""
+    if model_name not in _BERT_SPECS:
+        raise ValueError(f"unknown bert spec {model_name!r}; "
+                         f"known {sorted(_BERT_SPECS)}")
+    spec = dict(_BERT_SPECS[model_name])
+    spec.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, dropout=dropout,
+                     max_length=max_length, attention_impl=attention_impl,
+                     **spec)

@@ -40,6 +40,15 @@ def _default_dtype():
     return resolve_dtype(config.get("MXTPU_DEFAULT_DTYPE"))
 
 
+# Active AMP cast policy (set by mx.amp.init) — consulted per-op in invoke.
+_amp_policy = None
+
+
+def set_amp_policy(policy) -> None:
+    global _amp_policy
+    _amp_policy = policy
+
+
 def _narrow_x32(dt):
     """jax runs x32 by default; silently narrow 64-bit requests like the
     reference narrows to its supported dtype set."""
@@ -78,6 +87,13 @@ def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
         kwargs["rng"] = _random.next_key()
     in_nd = [as_nd(x) for x in inputs]
     in_data = [x._data for x in in_nd]
+    if _amp_policy is not None and name:
+        # fold the AMP casts INTO the differentiated function so vjp sees
+        # the dtype boundary and cotangents are cast back automatically
+        _policy, _inner, _opname = _amp_policy, fn, name
+
+        def fn(*arrays, **kw):
+            return _inner(*_policy.apply(_opname, list(arrays)), **kw)
 
     recording = autograd.is_recording() and differentiable
     if recording:

@@ -1,0 +1,181 @@
+"""Ring attention + Ulysses sequence parallelism.
+
+New capability beyond the reference (SURVEY.md §2.4 CP/SP rows — the
+reference has no attention kernels at all): long-context attention where the
+sequence axis is sharded over a mesh axis.
+
+* ``ring_attention``: each device holds a Q/K/V shard of the sequence; KV
+  shards rotate around the ICI ring via ``lax.ppermute`` while a streaming
+  (flash-style) softmax accumulates partial results — O(T/n) memory per
+  device, compute/comm overlapped by XLA's async collectives. Matches the
+  blockwise formulation of Liu et al. (Ring Attention, 2023).
+
+* ``ulysses_attention``: all-to-all head-scatter (DeepSpeed-Ulysses):
+  resharding (T/n, H) -> (T, H/n) so each device computes full-sequence
+  attention for a head subset, then the inverse all-to-all.
+
+Both are pure jax functions usable inside ``shard_map`` over a Mesh with a
+``seq`` axis; ``ring_attention_sharded`` wraps the shard_map plumbing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+
+def _flash_block(q, k, v, m_prev, l_prev, o_prev, causal_mask=None):
+    """One KV-block update of streaming softmax.
+
+    q: (B, H, Tq, D); k/v: (B, H, Tk, D); m/l: (B, H, Tq); o: like q.
+    Returns updated (m, l, o).
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale  # (B,H,Tq,Tk)
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -jnp.inf)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev),
+                      jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                   causal: bool = False):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call inside shard_map/pjit; q/k/v are the LOCAL shards (B, H, T_local,
+    D). KV rotates n_shards times around the ring.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    tq = q.shape[2]
+
+    m = jnp.full(q.shape[:3], -jnp.inf, q.dtype)
+    l = jnp.zeros(q.shape[:3], q.dtype)
+    o = jnp.zeros_like(q)
+
+    def body(i, carry):
+        m, l, o, k_blk, v_blk = carry
+        src_idx = (my_idx - i) % n  # which shard these keys came from
+        mask = None
+        if causal:
+            # global positions: q row r on shard my_idx is my_idx*tq + r
+            q_pos = my_idx * tq + jnp.arange(tq)
+            k_pos = src_idx * k_blk.shape[2] + jnp.arange(k_blk.shape[2])
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None]
+        m, l, o = _flash_block(q, k_blk, v_blk, m, l, o, mask)
+        # rotate KV to the next device (skip after the last block)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                           causal: bool = False):
+    """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; T is sharded
+    over ``axis_name`` of ``mesh``."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def ring_attention_nd(q, k, v, mask=None):
+    """NDArray-level entry used by MultiHeadAttention(attention_impl='ring').
+
+    Falls back to single-block flash when no mesh/axis is active (still a
+    streaming-softmax implementation, so numerics match the ring path).
+    """
+    from ..ndarray import invoke
+
+    def fn(q, k, v, mask=None):
+        m = jnp.full(q.shape[:3], -jnp.inf, q.dtype)
+        l = jnp.zeros(q.shape[:3], q.dtype)
+        o = jnp.zeros_like(q)
+        blk_mask = None
+        if mask is not None:
+            blk_mask = mask.astype(bool)
+        m, l, o = _flash_block(q, k, v, m, l, o, blk_mask)
+        return o / jnp.maximum(l, 1e-20)[..., None]
+
+    args = [q, k, v] + ([mask] if mask is not None else [])
+    return invoke(fn, args, name="ring_attention")
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                      causal: bool = False):
+    """DeepSpeed-Ulysses: all-to-all so each device sees the FULL sequence
+    for H/n heads, computes dense attention, then scatters back.
+
+    Local shards: (B, H, T_local, D) with H divisible by the axis size.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, t_local, d = q.shape
+    assert h % n == 0, f"heads {h} not divisible by seq-axis size {n}"
+
+    # all_to_all(tiled=False) consumes split_axis (size n) and inserts the
+    # gathered n-axis at concat_axis, indexed by SOURCE device.
+    def scatter_heads(x):
+        # (B, H, Tl, D) -> keep head-group my_idx, gather all seq blocks:
+        x = x.reshape(b, n, h // n, t_local, d)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                           tiled=False)          # (B, H/n, n, Tl, D)
+        return x.reshape(b, h // n, n * t_local, d)
+
+    def gather_heads(x):
+        # (B, H/n, T, D) -> send seq block i to device i, regather heads:
+        x = x.reshape(b, h // n, n, t_local, d)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=False)          # (B, n, H/n, Tl, D)
+        return x.reshape(b, h, t_local, d)
+
+    qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        t = s.shape[-1]
+        cm = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(cm, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    of = jnp.einsum("bhqk,bhkd->bhqd", w, vf)
+    return gather_heads(of)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh,
+                              axis_name: str = SEQ_AXIS,
+                              causal: bool = False):
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

@@ -1,0 +1,214 @@
+"""Profiler.
+
+Capability parity with reference ``python/mxnet/profiler.py`` over
+``src/profiler/profiler.cc`` (SURVEY.md §5 "Tracing/profiling"):
+``set_config``, ``set_state('run'/'stop')``, ``pause/resume``, scopes/
+markers (``Task``/``Frame``/``Event``/``Counter``, ``Marker``), ``dump``,
+and ``dumps`` (aggregate per-op stats).
+
+TPU-native redesign: device-side op timing comes from ``jax.profiler``
+(XPlane traces viewable in TensorBoard — tensorboard-plugin-profile is
+installed); the chrome://tracing JSON the reference emits is produced from
+host-side scope records here. ``jax.named_scope`` annotations flow into the
+XLA trace so op-level attribution survives fusion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+_state = {
+    "config": {"profile_all": False, "profile_symbolic": True,
+               "profile_imperative": True, "profile_memory": False,
+               "profile_api": False, "filename": "profile.json",
+               "aggregate_stats": False},
+    "running": False,
+    "jax_trace_dir": None,
+    "records": [],          # chrome trace events from host scopes
+    "counters": {},
+    "lock": threading.Lock(),
+}
+
+
+def set_config(**kwargs):
+    """Configure (reference ``profiler.set_config``). ``filename`` sets the
+    chrome-trace dump path; a sibling directory receives the XLA XPlane
+    trace for TensorBoard."""
+    _state["config"].update(kwargs)
+
+
+def set_state(state: str = "stop", profile_process: str = "worker"):
+    """'run' starts profiling (host scopes + jax device trace); 'stop' ends
+    it (reference ``profiler.set_state``)."""
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["records"] = []
+        trace_dir = os.path.splitext(
+            _state["config"].get("filename", "profile.json"))[0] + "_xplane"
+        _state["jax_trace_dir"] = trace_dir
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            _state["jax_trace_dir"] = None
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace_dir"] is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def is_running() -> bool:
+    return _state["running"]
+
+
+def pause(profile_process: str = "worker"):
+    _state["running"] = False
+
+
+def resume(profile_process: str = "worker"):
+    _state["running"] = True
+
+
+def _record(name, cat, ph, ts=None, dur=None, args=None):
+    with _state["lock"]:
+        ev = {"name": name, "cat": cat, "ph": ph,
+              "ts": (ts if ts is not None else time.perf_counter()) * 1e6,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if dur is not None:
+            ev["dur"] = dur * 1e6
+        if args:
+            ev["args"] = args
+        _state["records"].append(ev)
+
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    """Write the chrome://tracing JSON (reference ``profiler.dump``)."""
+    fname = _state["config"].get("filename", "profile.json")
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": _state["records"],
+                   "displayTimeUnit": "ms"}, f)
+    return fname
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate per-scope stats table (reference
+    ``MXAggregateProfileStatsPrint``)."""
+    agg: Dict[str, List[float]] = {}
+    for ev in _state["records"]:
+        if ev.get("ph") == "X":
+            agg.setdefault(ev["name"], []).append(ev.get("dur", 0.0))
+    lines = [f"{'Name':40s} {'Calls':>8s} {'Total(ms)':>12s} "
+             f"{'Avg(ms)':>10s} {'Max(ms)':>10s}"]
+    for name, durs in sorted(agg.items(),
+                             key=lambda kv: -sum(kv[1])):
+        total = sum(durs) / 1e3
+        lines.append(f"{name:40s} {len(durs):8d} {total:12.3f} "
+                     f"{total / len(durs):10.3f} {max(durs) / 1e3:10.3f}")
+    if reset:
+        _state["records"] = []
+    return "\n".join(lines)
+
+
+class Domain:
+    def __init__(self, name: str):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Scope:
+    _cat = "scope"
+
+    def __init__(self, domain: Optional[Domain], name: str):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+        self._jax_scope = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        self._jax_scope = jax.named_scope(self.name)
+        self._jax_scope.__enter__()
+        return self
+
+    def stop(self):
+        if self._jax_scope is not None:
+            self._jax_scope.__exit__(None, None, None)
+            self._jax_scope = None
+        if self._t0 is not None and _state["running"]:
+            _record(self.name, self._cat, "X", ts=self._t0,
+                    dur=time.perf_counter() - self._t0)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scope):
+    _cat = "task"
+
+
+class Frame(_Scope):
+    _cat = "frame"
+
+
+class Event(_Scope):
+    _cat = "event"
+
+    def __init__(self, name: str):
+        super().__init__(None, name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.name = name
+        self._value = value or 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if _state["running"]:
+            _record(self.name, "counter", "C",
+                    args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _state["running"]:
+            _record(self.name, "marker", "i")
+
+
+def scope(name: str):
+    """Convenience profiling scope also visible in the XLA trace."""
+    return Event(name)

@@ -1,0 +1,216 @@
+"""RecordIO: sequential and indexed record files.
+
+Capability parity with reference ``python/mxnet/recordio.py`` + dmlc-core
+``recordio.h`` (SURVEY.md §2.1 "C++ data pipeline"): ``MXRecordIO`` /
+``MXIndexedRecordIO`` readers+writers with the dmlc on-disk format (magic +
+lrecord framing, 4-byte alignment), ``IRHeader`` pack/unpack, and
+``pack_img``/``unpack_img`` JPEG payloads (PIL codec here; the reference
+uses OpenCV).
+
+The binary format matches dmlc so record packs are interchangeable with the
+reference's at the byte level.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+_MAGIC = 0xCED7230A
+_LREC_KIND_BITS = 29
+_LREC_LEN_MASK = (1 << _LREC_KIND_BITS) - 1
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential RecordIO file (reference ``mx.recordio.MXRecordIO``)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError(f"invalid flag {self.flag!r}")
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["handle"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def tell(self) -> int:
+        return self.handle.tell()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        # dmlc lrecord: upper 3 bits = continuation kind (0 for whole
+        # record), lower 29 = payload length; 4-byte aligned
+        if len(buf) > _LREC_LEN_MASK:
+            raise ValueError("record too large (>512MB); dmlc splits these "
+                             "— unsupported here")
+        self.handle.write(struct.pack("<II", _MAGIC, len(buf)))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"invalid RecordIO magic {magic:#x} in {self.uri}")
+        length = lrec & _LREC_LEN_MASK
+        buf = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a .idx sidecar for random access (reference
+    ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.handle is None:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        assert self.writable
+        pos = self.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+# keep the reference aliases
+IndexedRecordIO = MXIndexedRecordIO
+RecordIO = MXRecordIO
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload (reference ``mx.recordio.pack``)."""
+    flag = header.flag
+    label = header.label
+    if isinstance(label, (np.ndarray, list, tuple)):
+        label = np.asarray(label, np.float32)
+        flag = label.size
+        payload_label = label.tobytes()
+        head = struct.pack(_IR_FORMAT, flag, 0.0, header.id, header.id2)
+        return head + payload_label + s
+    head = struct.pack(_IR_FORMAT, flag, float(label), header.id, header.id2)
+    return head + s
+
+
+def unpack(s: bytes):
+    """Unpack a record into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an image (HWC uint8) and pack (reference ``pack_img``)."""
+    import io
+
+    from PIL import Image
+
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        pil = Image.fromarray(arr, "L")
+    else:
+        pil = Image.fromarray(arr[..., :3])
+    buf = io.BytesIO()
+    fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor=1):
+    """Unpack + decode an image record -> (IRHeader, HWC uint8 array)."""
+    import io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    pil = Image.open(io.BytesIO(payload))
+    if iscolor == 0:
+        pil = pil.convert("L")
+        arr = np.asarray(pil)[..., None]
+    else:
+        pil = pil.convert("RGB")
+        arr = np.asarray(pil)
+    return header, arr

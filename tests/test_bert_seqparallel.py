@@ -1,0 +1,157 @@
+"""BERT / transformer + ring-attention / Ulysses sequence parallelism
+(BASELINE config[2]; SURVEY.md §2.4 SP/CP rows — new capability)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.models import BERTModel, get_bert
+from incubator_mxnet_tpu.models.transformer import (MultiHeadAttention,
+                                                    TransformerEncoderCell)
+
+
+def _tiny_bert(**kw):
+    args = dict(vocab_size=100, units=32, hidden_size=64, num_layers=2,
+                num_heads=4, max_length=64, dropout=0.1)
+    args.update(kw)
+    return BERTModel(**args)
+
+
+def test_bert_forward_shapes():
+    net = _tiny_bert()
+    net.initialize(init='xavier')
+    tokens = mx.nd.array(np.random.randint(0, 100, (2, 16)), dtype='int32')
+    segs = mx.nd.zeros((2, 16), dtype='int32')
+    vlen = mx.nd.array([16, 10])
+    seq, pooled, mlm = net(tokens, segs, vlen)
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+    assert mlm.shape == (2, 16, 100)
+
+
+def test_bert_factory_specs():
+    net = get_bert("bert_12_768_12", vocab_size=50, num_layers=1)
+    assert net._units == 768
+    with pytest.raises(ValueError):
+        get_bert("bert_nope")
+
+
+def test_bert_mlm_training_step_converges():
+    np.random.seed(0)
+    net = _tiny_bert(dropout=0.0)
+    net.initialize(init='xavier')
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens_np = np.random.randint(0, 100, (4, 12))
+    tokens = mx.nd.array(tokens_np, dtype='int32')
+    labels = mx.nd.array(tokens_np)
+    first = None
+    for _ in range(15):
+        with mx.autograd.record():
+            _, _, mlm = net(tokens)
+            l = loss_fn(mlm, labels).mean()
+        l.backward()
+        trainer.step(4)
+        if first is None:
+            first = float(l.asscalar())
+    assert float(l.asscalar()) < first
+
+
+def test_mha_matches_manual_attention():
+    mha = MultiHeadAttention(16, 4)
+    mha.initialize(init='xavier')
+    x = mx.nd.uniform(shape=(2, 6, 16))
+    out = mha(x)
+    assert out.shape == (2, 6, 16)
+    # ring (streaming-softmax) impl must match the XLA softmax impl
+    mha._impl = "ring"
+    out_ring = mha(x)
+    np.testing.assert_allclose(out.asnumpy(), out_ring.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_encoder_cell_gradients():
+    cell = TransformerEncoderCell(32, 64, 4, dropout=0.0)
+    cell.initialize(init='xavier')
+    x = mx.nd.uniform(shape=(2, 8, 32))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = (cell(x) ** 2).sum()
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# sequence parallelism on the 8-device CPU mesh
+# ---------------------------------------------------------------------------
+def _dense_attention(q, k, v, causal=False):
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d)
+    if causal:
+        t = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import ring_attention as ra
+
+    np.random.seed(0)
+    q, k, v = (jnp.asarray(np.random.randn(2, 4, 32, 8).astype(np.float32))
+               for _ in range(3))
+    mesh = parallel.make_mesh({"seq": 8})
+    out = ra.ring_attention_sharded(q, k, v, mesh, causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import ring_attention as ra
+
+    np.random.seed(1)
+    q, k, v = (jnp.asarray(np.random.randn(2, 8, 32, 8).astype(np.float32))
+               for _ in range(3))
+    mesh = parallel.make_mesh({"seq": 8})
+    out = ra.ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bert_spmd_training_dp():
+    """BERT through the fused SPMD step on the full mesh (config[2] slice)."""
+    np.random.seed(0)
+    net = _tiny_bert(dropout=0.0)
+    net.initialize(init='xavier')
+    tokens_np = np.random.randint(0, 100, (8, 12))
+    # resolve shapes eagerly once
+    net(mx.nd.array(tokens_np, dtype='int32'))
+
+    class MLMLoss(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(1.0, 0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, seq, pooled, mlm, labels):
+            return self._ce(mlm, labels)
+
+    mesh = parallel.make_mesh({"data": -1})
+    st = parallel.SPMDTrainer(net, MLMLoss(), "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh)
+    x = tokens_np.astype(np.int32)
+    y = tokens_np.astype(np.float32)
+    losses = [float(st.step(x, y)) for _ in range(10)]
+    assert losses[-1] < losses[0]

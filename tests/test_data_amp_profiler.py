@@ -1,0 +1,280 @@
+"""gluon.data / recordio / image / amp / profiler tests
+(reference test_gluon_data.py, test_recordio.py, test_amp.py patterns)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler,
+                                            DataLoader, RandomSampler,
+                                            SequentialSampler, SimpleDataset)
+
+
+# ---------------------------------------------------------------------------
+# datasets / samplers / dataloader
+# ---------------------------------------------------------------------------
+def test_array_dataset_and_transform():
+    x = np.arange(20).reshape(10, 2).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 10
+    a, b = ds[3]
+    np.testing.assert_allclose(a, x[3])
+    ds2 = ds.transform_first(lambda d: d * 2)
+    a2, b2 = ds2[3]
+    np.testing.assert_allclose(a2, x[3] * 2)
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = BatchSampler(SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = BatchSampler(SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # leftover rolls into next epoch
+
+
+def test_dataloader_basic_and_workers():
+    x = np.random.rand(17, 4).astype(np.float32)
+    y = np.arange(17).astype(np.float32)
+    ds = ArrayDataset(x, y)
+    for workers in (0, 2):
+        loader = DataLoader(ds, batch_size=5, shuffle=False,
+                            num_workers=workers)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert batches[0][0].shape == (5, 4)
+        assert batches[-1][0].shape == (2, 4)
+        np.testing.assert_allclose(batches[0][1].asnumpy(), y[:5])
+
+
+def test_dataloader_shuffle_covers_all():
+    ds = SimpleDataset(list(range(12)))
+    loader = DataLoader(ds, batch_size=4, shuffle=True)
+    seen = []
+    for b in loader:
+        seen.extend(b.asnumpy().astype(int).tolist())
+    assert sorted(seen) == list(range(12))
+
+
+def test_mnist_synthetic_and_transforms():
+    from incubator_mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+    ds = MNIST(synthetic=True)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.13, 0.31)])
+    ds2 = ds.transform_first(tf)
+    img2, _ = ds2[0]
+    assert img2.shape == (1, 28, 28)
+    loader = DataLoader(ds2, batch_size=32)
+    batch = next(iter(loader))
+    assert batch[0].shape == (32, 1, 28, 28)
+
+
+def test_transforms_shapes():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    img = mx.nd.array((np.random.rand(40, 60, 3) * 255).astype(np.uint8))
+    assert transforms.Resize((30, 20))(img).shape == (20, 30, 3)
+    assert transforms.Resize(20)(img).shape == (20, 30, 3)  # short side
+    assert transforms.CenterCrop(16)(img).shape == (16, 16, 3)
+    assert transforms.RandomResizedCrop(24)(img).shape == (24, 24, 3)
+    out = transforms.RandomFlipLeftRight()(img)
+    assert out.shape == (40, 60, 3)
+    jit = transforms.RandomColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert jit.shape == (40, 60, 3)
+
+
+# ---------------------------------------------------------------------------
+# recordio
+# ---------------------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    from incubator_mxnet_tpu import recordio
+
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        buf = r.read()
+        if buf is None:
+            break
+        got.append(buf.decode())
+    assert got == [f"record-{i}" for i in range(5)]
+
+
+def test_indexed_recordio_and_pack_img(tmp_path):
+    from incubator_mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "img.rec")
+    idx_path = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    imgs = {}
+    for i in range(3):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        imgs[i] = img
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == [0, 1, 2]
+    header, img = recordio.unpack_img(r.read_idx(1))
+    assert header.label == 1.0
+    np.testing.assert_array_equal(img, imgs[1])  # png is lossless
+
+
+def test_pack_unpack_multilabel():
+    from incubator_mxnet_tpu import recordio
+
+    header = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert payload == b"payload"
+
+
+def test_image_record_dataset(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    from incubator_mxnet_tpu.gluon.data import RecordFileDataset
+
+    rec_path = str(tmp_path / "ds.rec")
+    idx_path = str(tmp_path / "ds.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        w.write_idx(i, f"item{i}".encode())
+    w.close()
+    ds = RecordFileDataset(rec_path)
+    assert len(ds) == 4
+    assert ds[2] == b"item2"
+
+
+def test_imageiter_from_imglist(tmp_path):
+    from incubator_mxnet_tpu import image as img_mod
+
+    # write tiny npy "images" via an ImageFolder-like list using PIL files
+    from PIL import Image
+
+    paths = []
+    for i in range(4):
+        arr = (np.random.rand(10, 10, 3) * 255).astype(np.uint8)
+        p = str(tmp_path / f"im{i}.png")
+        Image.fromarray(arr).save(p)
+        paths.append((float(i), f"im{i}.png"))
+    it = img_mod.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                           imglist=paths, path_root=str(tmp_path),
+                           aug_list=img_mod.CreateAugmenter(
+                               (3, 8, 8), rand_crop=True, rand_mirror=True))
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 8, 8)
+
+
+# ---------------------------------------------------------------------------
+# amp
+# ---------------------------------------------------------------------------
+def test_amp_policy_casts_matmul():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import amp
+
+    amp.init(target_dtype="bfloat16")
+    try:
+        a = mx.nd.ones((4, 4))
+        b = mx.nd.ones((4, 4))
+        out = mx.nd.dot(a, b)
+        assert out.dtype == jnp.bfloat16
+        # fp32 op stays fp32
+        s = mx.nd.softmax(a.astype("bfloat16"))
+        assert s.dtype == jnp.float32
+    finally:
+        amp.deinit()
+
+
+def test_amp_training_with_loss_scaling():
+    from incubator_mxnet_tpu import amp
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    scaler = amp.init_trainer(trainer)
+    x = mx.nd.uniform(shape=(4, 8))
+    y = mx.nd.uniform(shape=(4, 4))
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(3):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+            with amp.scale_loss(l, trainer) as scaled:
+                mx.autograd.backward(scaled)
+        trainer.step(4)
+    assert np.isfinite(net.weight.data().asnumpy()).all()
+    assert scaler.loss_scale >= 1.0
+
+
+def test_amp_overflow_skips_update():
+    from incubator_mxnet_tpu import amp
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    scaler = amp.init_trainer(trainer)
+    w0 = net.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        l = (net(mx.nd.ones((2, 2))) * np.inf).sum()
+    l.backward()
+    s0 = scaler.loss_scale
+    trainer.step(2)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    assert scaler.loss_scale < s0
+
+
+def test_convert_model():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import amp
+
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    amp.convert_model(net, "bfloat16")
+    assert net.weight.data().dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+def test_profiler_scopes_and_dump(tmp_path):
+    from incubator_mxnet_tpu import profiler
+
+    fname = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.scope("my_computation"):
+        a = mx.nd.ones((32, 32))
+        (a @ a).wait_to_read()
+    dom = profiler.Domain("app")
+    c = dom.new_counter("items", 0)
+    c.increment(5)
+    with dom.new_task("task1"):
+        pass
+    profiler.set_state("stop")
+    out = profiler.dump()
+    assert os.path.exists(out)
+    import json
+
+    with open(out) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "my_computation" in names
+    assert "task1" in names
+    table = profiler.dumps()
+    assert "my_computation" in table
