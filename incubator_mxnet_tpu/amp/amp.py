@@ -28,7 +28,8 @@ from .loss_scaler import LossScaler
 
 class AmpPolicy:
     def __init__(self, target_dtype="bfloat16",
-                 target_dtype_ops=None, fp32_ops=None, widest_ops=None):
+                 target_dtype_ops=None, fp32_ops=None, widest_ops=None,
+                 conditional_fp32_ops=None):
         self.target_dtype = resolve_dtype(target_dtype)
         self.target_ops = set(target_dtype_ops
                               if target_dtype_ops is not None
@@ -37,8 +38,16 @@ class AmpPolicy:
                             else lists.FP32_OPS)
         self.widest_ops = set(widest_ops if widest_ops is not None
                               else lists.WIDEST_TYPE_CASTS)
+        # reference format: [(op_name, param_name, [values])] — the op runs
+        # fp32 only when the named attribute takes one of the listed values
+        self.conditional_fp32 = {}
+        for op_name, param_name, values in (
+                conditional_fp32_ops if conditional_fp32_ops is not None
+                else lists.CONDITIONAL_FP32_OPS):
+            self.conditional_fp32.setdefault(op_name, []).append(
+                (param_name, {str(v) for v in values}))
 
-    def apply(self, name: str, in_data):
+    def apply(self, name: str, in_data, kwargs=None):
         def is_float(a):
             return jnp.issubdtype(a.dtype, jnp.floating)
 
@@ -48,6 +57,12 @@ class AmpPolicy:
         if name in self.fp32_ops:
             return [jnp.asarray(a, jnp.float32) if is_float(a) else a
                     for a in in_data]
+        if name in self.conditional_fp32:
+            kw = kwargs or {}
+            for param_name, values in self.conditional_fp32[name]:
+                if str(kw.get(param_name)) in values:
+                    return [jnp.asarray(a, jnp.float32) if is_float(a)
+                            else a for a in in_data]
         if name in self.widest_ops:
             floats = [a.dtype for a in in_data if is_float(a)]
             if len(set(floats)) > 1:
@@ -61,7 +76,8 @@ class AmpPolicy:
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None, layout_optimization=False):
     """Enable AMP globally (reference ``amp.init``)."""
-    policy = AmpPolicy(target_dtype, target_precision_ops, fp32_ops)
+    policy = AmpPolicy(target_dtype, target_precision_ops, fp32_ops,
+                       conditional_fp32_ops=conditional_fp32_ops)
     _ndimpl.set_amp_policy(policy)
     return policy
 

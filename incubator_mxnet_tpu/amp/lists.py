@@ -22,6 +22,13 @@ FP32_OPS = [
     "erfinv", "logsumexp", "cumsum",
 ]
 
+# [(op_name, param_name, [values])]: run fp32 only when the attribute takes
+# one of the listed values (reference CONDITIONAL_FP32_FUNCS — e.g.
+# softrelu activation overflows exp() in fp16)
+CONDITIONAL_FP32_OPS = [
+    ("Activation", "act_type", ["softrelu"]),
+]
+
 WIDEST_TYPE_CASTS = [
     "add", "subtract", "multiply", "divide", "broadcast_add",
     "broadcast_sub", "broadcast_mul", "broadcast_div", "concat", "stack",

@@ -122,16 +122,31 @@ class BERTEncoder(HybridBlock):
 class BERTModel(HybridBlock):
     """BERT with MLM + NSP heads (GluonNLP ``BERTModel`` capability).
 
-    forward(token_ids, segment_ids, valid_length) ->
-        (sequence_output, pooled_output, mlm_scores)
+    Heads are opt-in via constructor flags (GluonNLP semantics) so that a
+    head that is not part of the training objective is simply not
+    registered — every registered parameter participates in every forward,
+    keeping the eager ``Trainer.step`` stale-gradient check satisfied.
+
+    forward(token_ids, segment_ids, valid_length) -> tuple of
+        sequence_output,
+        pooled_output (if use_pooler),
+        mlm_scores    (if use_decoder),
+        nsp_scores    (if use_classifier; requires use_pooler)
     """
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  type_vocab_size=2, dropout=0.1, attention_impl="xla",
+                 use_pooler=True, use_decoder=True, use_classifier=True,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        if use_classifier and not use_pooler:
+            raise ValueError("use_classifier=True requires use_pooler=True "
+                             "(NSP scores come from the pooled output)")
         with self.name_scope():
             self.word_embed = Embedding(vocab_size, units)
             self.token_type_embed = Embedding(type_vocab_size, units)
@@ -140,15 +155,18 @@ class BERTModel(HybridBlock):
             self.embed_dropout = Dropout(dropout)
             self.encoder = BERTEncoder(num_layers, units, hidden_size,
                                        num_heads, dropout, attention_impl)
-            self.pooler = Dense(units, in_units=units, activation="tanh")
-            self.nsp_classifier = Dense(2, in_units=units)
-            self.mlm_decoder = HybridSequential(prefix="mlm_")
-            with self.mlm_decoder.name_scope():
-                self.mlm_decoder.add(
-                    Dense(units, flatten=False, in_units=units,
-                          activation="gelu"),
-                    LayerNorm(in_channels=units),
-                    Dense(vocab_size, flatten=False, in_units=units))
+            if use_pooler:
+                self.pooler = Dense(units, in_units=units, activation="tanh")
+            if use_classifier:
+                self.nsp_classifier = Dense(2, in_units=units)
+            if use_decoder:
+                self.mlm_decoder = HybridSequential(prefix="mlm_")
+                with self.mlm_decoder.name_scope():
+                    self.mlm_decoder.add(
+                        Dense(units, flatten=False, in_units=units,
+                              activation="gelu"),
+                        LayerNorm(in_channels=units),
+                        Dense(vocab_size, flatten=False, in_units=units))
 
     def forward(self, token_ids, segment_ids=None, valid_length=None):
         from .. import ndarray as F
@@ -159,9 +177,12 @@ class BERTModel(HybridBlock):
         pos = invoke(lambda x: jnp.broadcast_to(
             jnp.arange(x.shape[1], dtype=jnp.int32), x.shape),
             [token_ids], name="positions", differentiable=False)
-        emb = self.word_embed(token_ids) + self.position_embed(pos)
-        if segment_ids is not None:
-            emb = emb + self.token_type_embed(segment_ids)
+        if segment_ids is None:
+            # default to segment 0 everywhere: token_type_embed must
+            # contribute (and receive gradient) on every forward
+            segment_ids = F.zeros_like(token_ids)
+        emb = (self.word_embed(token_ids) + self.position_embed(pos)
+               + self.token_type_embed(segment_ids))
         emb = self.embed_dropout(self.embed_ln(emb))
 
         mask = None
@@ -171,9 +192,15 @@ class BERTModel(HybridBlock):
                             < vl.reshape(-1, 1, 1, 1)).astype(jnp.float32),
                 [valid_length], name="attn_mask", differentiable=False)
         seq = self.encoder(emb, mask)
-        pooled = self.pooler(seq.slice_axis(1, 0, 1).squeeze(1))
-        mlm = self.mlm_decoder(seq)
-        return seq, pooled, mlm
+        outputs = [seq]
+        if self._use_pooler:
+            pooled = self.pooler(seq.slice_axis(1, 0, 1).squeeze(1))
+            outputs.append(pooled)
+        if self._use_decoder:
+            outputs.append(self.mlm_decoder(seq))
+        if self._use_classifier:
+            outputs.append(self.nsp_classifier(pooled))
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
 
 
 _BERT_SPECS = {

@@ -93,7 +93,7 @@ def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
         _policy, _inner, _opname = _amp_policy, fn, name
 
         def fn(*arrays, **kw):
-            return _inner(*_policy.apply(_opname, list(arrays)), **kw)
+            return _inner(*_policy.apply(_opname, list(arrays), kw), **kw)
 
     recording = autograd.is_recording() and differentiable
     if recording:

@@ -334,19 +334,22 @@ class AdamW(Optimizer):
         rescale, clip = self.rescale_grad, self.clip_gradient
         m, v = state
 
-        def fn(w, g, m, v, lr, wd):
+        def fn(w, g, m, v, lr, wd, correction):
+            # correction is a traced scalar: baking it into the closure
+            # would freeze the t=1 bias correction into the jit cache
             lr_t = lr.astype(w.dtype)
             g = g.astype(w.dtype) * rescale
             if clip is not None:
                 g = jnp.clip(g, -clip, clip)
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * jnp.square(g)
-            w = w - lr_t * (correction * m / (jnp.sqrt(v) + eps)
+            w = w - lr_t * (correction.astype(w.dtype) * m
+                            / (jnp.sqrt(v) + eps)
                             + wd.astype(w.dtype) * w)
             return w, (m, v)
 
         return self._run("adamw", fn, weight, grad._data, (m, v),
-                         dict(lr=lr, wd=wd))
+                         dict(lr=lr, wd=wd, correction=correction))
 
 
 @register

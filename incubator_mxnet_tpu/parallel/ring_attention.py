@@ -94,7 +94,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
                            causal: bool = False):
     """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; T is sharded
     over ``axis_name`` of ``mesh``."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
 
@@ -102,7 +102,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
         functools.partial(ring_attention, axis_name=axis_name,
                           causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
 
 
@@ -170,12 +170,12 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
 def ulysses_attention_sharded(q, k, v, mesh: Mesh,
                               axis_name: str = SEQ_AXIS,
                               causal: bool = False):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)

@@ -159,15 +159,17 @@ RecordIO = MXRecordIO
 
 def pack(header: IRHeader, s: bytes) -> bytes:
     """Pack a header + payload (reference ``mx.recordio.pack``)."""
-    flag = header.flag
     label = header.label
     if isinstance(label, (np.ndarray, list, tuple)):
         label = np.asarray(label, np.float32)
-        flag = label.size
         payload_label = label.tobytes()
-        head = struct.pack(_IR_FORMAT, flag, 0.0, header.id, header.id2)
+        head = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                           header.id2)
         return head + payload_label + s
-    head = struct.pack(_IR_FORMAT, flag, float(label), header.id, header.id2)
+    # scalar label: the flag field doubles as the vector-label size on
+    # unpack, so it must be forced to 0 here — a caller-supplied flag>0
+    # would make unpack eat flag*4 payload bytes as a label array
+    head = struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2)
     return head + s
 
 

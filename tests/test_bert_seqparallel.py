@@ -24,10 +24,11 @@ def test_bert_forward_shapes():
     tokens = mx.nd.array(np.random.randint(0, 100, (2, 16)), dtype='int32')
     segs = mx.nd.zeros((2, 16), dtype='int32')
     vlen = mx.nd.array([16, 10])
-    seq, pooled, mlm = net(tokens, segs, vlen)
+    seq, pooled, mlm, nsp = net(tokens, segs, vlen)
     assert seq.shape == (2, 16, 32)
     assert pooled.shape == (2, 32)
     assert mlm.shape == (2, 16, 100)
+    assert nsp.shape == (2, 2)
 
 
 def test_bert_factory_specs():
@@ -38,8 +39,10 @@ def test_bert_factory_specs():
 
 
 def test_bert_mlm_training_step_converges():
+    """MLM-only config: heads outside the objective are not registered, so
+    the eager Trainer stale-grad check passes without ignore_stale_grad."""
     np.random.seed(0)
-    net = _tiny_bert(dropout=0.0)
+    net = _tiny_bert(dropout=0.0, use_pooler=False, use_classifier=False)
     net.initialize(init='xavier')
     trainer = gluon.Trainer(net.collect_params(), 'adam',
                             {'learning_rate': 1e-3})
@@ -50,10 +53,41 @@ def test_bert_mlm_training_step_converges():
     first = None
     for _ in range(15):
         with mx.autograd.record():
-            _, _, mlm = net(tokens)
+            _, mlm = net(tokens)
             l = loss_fn(mlm, labels).mean()
         l.backward()
         trainer.step(4)
+        if first is None:
+            first = float(l.asscalar())
+    assert float(l.asscalar()) < first
+
+
+def test_bert_pretraining_step_all_params_fresh():
+    """Full MLM+NSP objective on the default model: every registered
+    parameter gets a gradient — no stale-grad warning from Trainer.step."""
+    import warnings
+
+    np.random.seed(0)
+    net = _tiny_bert(dropout=0.0)
+    net.initialize(init='xavier')
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 1e-3})
+    mlm_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    nsp_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens_np = np.random.randint(0, 100, (4, 12))
+    tokens = mx.nd.array(tokens_np, dtype='int32')
+    labels = mx.nd.array(tokens_np)
+    nsp_labels = mx.nd.array(np.random.randint(0, 2, (4,)))
+    first = None
+    for _ in range(10):
+        with mx.autograd.record():
+            _, _, mlm, nsp = net(tokens)
+            l = (mlm_loss(mlm, labels).mean()
+                 + nsp_loss(nsp, nsp_labels).mean())
+        l.backward()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            trainer.step(4)
         if first is None:
             first = float(l.asscalar())
     assert float(l.asscalar()) < first
@@ -134,7 +168,7 @@ def test_ulysses_attention_matches_dense(causal):
 def test_bert_spmd_training_dp():
     """BERT through the fused SPMD step on the full mesh (config[2] slice)."""
     np.random.seed(0)
-    net = _tiny_bert(dropout=0.0)
+    net = _tiny_bert(dropout=0.0, use_classifier=False)
     net.initialize(init='xavier')
     tokens_np = np.random.randint(0, 100, (8, 12))
     # resolve shapes eagerly once

@@ -278,3 +278,33 @@ def test_profiler_scopes_and_dump(tmp_path):
     assert "task1" in names
     table = profiler.dumps()
     assert "my_computation" in table
+
+
+def test_pack_scalar_label_forces_flag_zero():
+    """Regression: a caller-supplied flag>0 with a scalar label must not
+    make unpack eat flag*4 payload bytes as a label vector."""
+    from incubator_mxnet_tpu import recordio
+
+    header = recordio.IRHeader(3, 5.0, 11, 0)  # bogus nonzero flag
+    s = recordio.pack(header, b"payloadpayload")
+    h2, payload = recordio.unpack(s)
+    assert h2.flag == 0
+    assert h2.label == 5.0
+    assert payload == b"payloadpayload"
+
+
+def test_amp_conditional_fp32_ops():
+    """conditional_fp32_ops: op runs fp32 only when the named attribute
+    takes one of the listed values."""
+    from incubator_mxnet_tpu import amp
+
+    amp.init(target_dtype="float16",
+             conditional_fp32_ops=[("Activation", "act_type", ["softrelu"])])
+    try:
+        x = mx.nd.ones((4,), dtype="float16")
+        out_cond = mx.nd.Activation(x, act_type="softrelu")
+        out_plain = mx.nd.Activation(x, act_type="relu")
+        assert out_cond.dtype == np.float32
+        assert out_plain.dtype == np.float16
+    finally:
+        amp.deinit()

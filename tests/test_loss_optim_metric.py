@@ -307,3 +307,21 @@ def test_mlp_mnist_end_to_end():
             trainer.step(x.shape[0])
             acc.update(y, out)
     assert acc.get()[1] > 0.9, f"final train acc {acc.get()[1]}"
+
+
+def test_adamw_bias_correction_not_frozen():
+    """Regression: AdamW's per-step bias correction must be a traced
+    argument, not a constant baked into the first step's jitted closure.
+    With beta1=0.9 and a constant grad of 1, the bias-corrected Adam
+    term is exactly g/(sqrt(g^2)+eps) ~= 1 for every t, so each step
+    moves w by ~lr regardless of t. A frozen t=1 correction instead
+    reuses sqrt(1-b2)/(1-b1) ~= 0.316 for all later steps."""
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("adamw", learning_rate=0.1, wd=0.0, epsilon=1e-8)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.array(np.array([1.0], dtype=np.float32))
+    for _ in range(2):
+        updater(0, mx.nd.array(np.array([1.0], dtype=np.float32)), w)
+    # step1: w = 1 - 0.1*1 = 0.9 ; step2: w = 0.9 - 0.1*1 = 0.8
+    np.testing.assert_allclose(w.asnumpy(), [0.8], atol=1e-3)
