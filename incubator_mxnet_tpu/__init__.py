@@ -58,13 +58,13 @@ def __getattr__(name):
     # Lazy subpackages to keep import light and avoid cycles.
     if name in ("gluon", "optimizer", "initializer", "lr_scheduler",
                 "kvstore", "metric", "io", "image", "recordio", "amp",
-                "profiler", "parallel", "symbol", "sym", "module", "model_zoo",
-                "test_utils", "onnx"):
+                "profiler", "parallel", "symbol", "sym", "module", "mod",
+                "model", "executor", "model_zoo", "test_utils", "onnx"):
         import importlib
 
         mod = importlib.import_module(
-            "." + {"sym": "symbol", "model_zoo": "gluon.model_zoo"}.get(
-                name, name), __name__)
+            "." + {"sym": "symbol", "mod": "module",
+                   "model_zoo": "gluon.model_zoo"}.get(name, name), __name__)
         setattr(_sys.modules[__name__], name, mod)
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -563,9 +563,115 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Placeholder for graph-import blocks (reference ``SymbolBlock``);
-    arrives with the symbol module."""
+    """Run a symbolic graph as a Gluon block (reference ``SymbolBlock``:
+    imports a ``Symbol`` + params into the imperative world).
 
-    def __init__(self, outputs=None, inputs=None, params=None):
-        raise NotImplementedError(
-            "SymbolBlock arrives with the symbol/module shim")
+    Free variables of the graph that are not ``inputs`` become this
+    block's Parameters (aux states — BatchNorm moving stats — become
+    ``grad_req='null'`` parameters). Forward evaluates the whole graph as
+    ONE invoked op so the autograd tape sees a single differentiable node,
+    the imperative analog of the reference's cached-graph import.
+    """
+
+    def __init__(self, outputs, inputs, params=None, prefix=None):
+        super().__init__(prefix=prefix, params=params)
+        from ..symbol.symbol import Symbol, Group
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._sym_outputs = outputs
+        self._input_names = [
+            s.name if isinstance(s, Symbol) else str(s) for s in ins]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        self._sym_arg_names = [n for n in arg_names
+                               if n not in self._input_names]
+        self._sym_aux_names = list(aux_names)
+        with self.name_scope():
+            for n in self._sym_arg_names:
+                setattr(self, n, Parameter(n, allow_deferred_init=True))
+            for n in self._sym_aux_names:
+                setattr(self, n, Parameter(n, grad_req="null",
+                                           allow_deferred_init=True))
+        # any stochastic node (Dropout, …) makes the fused op consume RNG
+        from ..ops import registry as _reg
+
+        self._stochastic = any(
+            (not n.is_variable) and _reg.get(n.op).needs_rng
+            for n in outputs._topo_nodes())
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file=None, ctx=None):
+        """Build a SymbolBlock from ``export()``-style artifacts
+        (reference ``SymbolBlock.imports``)."""
+        from .. import symbol as sym_mod
+
+        symbol = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        block = SymbolBlock(symbol, inputs)
+        if param_file is not None:
+            block.load_parameters(param_file, ctx=ctx)
+        return block
+
+    def forward(self, *args):
+        import jax
+
+        from .. import autograd as _ag
+        from ..executor import _interpret
+        from ..ndarray.ndarray import NDArray, as_nd, invoke
+
+        if len(args) != len(self._input_names):
+            raise ValueError(
+                f"SymbolBlock expects {len(self._input_names)} inputs "
+                f"{self._input_names}, got {len(args)}")
+        in_nd = [as_nd(a) for a in args]
+        # resolve deferred parameter shapes via symbolic shape inference
+        if any(self._reg_params[n]._data is None
+               for n in self._sym_arg_names + self._sym_aux_names):
+            known = {n: a.shape for n, a in zip(self._input_names, in_nd)}
+            arg_shapes, _, aux_shapes = \
+                self._sym_outputs.infer_shape_partial(**known)
+            all_args = self._sym_outputs.list_arguments()
+            for n, s in zip(all_args, arg_shapes):
+                p = self._reg_params.get(n)
+                if p is not None and p._data is None and s is not None:
+                    p.shape = tuple(s)
+                    p._finish_deferred_init(p.shape)
+            for n, s in zip(self._sym_outputs.list_auxiliary_states(),
+                            aux_shapes):
+                p = self._reg_params.get(n)
+                if p is not None and p._data is None and s is not None:
+                    p.shape = tuple(s)
+                    p._finish_deferred_init(p.shape)
+
+        sym = self._sym_outputs
+        input_names = list(self._input_names)
+        arg_names = list(self._sym_arg_names)
+        aux_names = list(self._sym_aux_names)
+        is_train = _ag.is_training()
+        n_outs = len(sym._entries)
+
+        def fused(*arrays, rng=None):
+            feeds = dict(zip(input_names + arg_names, arrays[:len(
+                input_names) + len(arg_names)]))
+            aux = dict(zip(aux_names,
+                           arrays[len(input_names) + len(arg_names):]))
+            key = rng if rng is not None else jax.random.PRNGKey(0)
+            outs, new_aux = _interpret(sym, feeds, aux, is_train, key)
+            return tuple(outs) + tuple(new_aux[n] for n in aux_names)
+
+        params = [self._reg_params[n].data() for n in arg_names]
+        auxs = [self._reg_params[n].data() for n in aux_names]
+        result = invoke(fused, list(in_nd) + params + auxs, {},
+                        name="SymbolBlock", differentiable=True,
+                        needs_rng=self._stochastic)
+        result = result if isinstance(result, tuple) else (result,)
+        outs, new_aux = result[:n_outs], result[n_outs:]
+        if is_train and new_aux:
+            with _ag.pause():
+                for n, v in zip(aux_names, new_aux):
+                    self._reg_params[n].data()._set_data(v._data)
+        return outs[0] if len(outs) == 1 else list(outs)

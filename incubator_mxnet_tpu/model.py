@@ -1,0 +1,25 @@
+"""``mx.model`` — checkpoint helpers (reference ``python/mxnet/model.py``
+surface that survived into the Module era)."""
+
+from __future__ import annotations
+
+from . import ndarray as nd
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params,
+                    aux_params) -> None:
+    """``prefix-symbol.json`` + ``prefix-%04d.params`` (reference
+    ``mx.model.save_checkpoint``)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """→ (symbol, arg_params, aux_params) (reference
+    ``mx.model.load_checkpoint``)."""
+    from .module.module import Module
+
+    return Module.load_checkpoint(prefix, epoch)

@@ -1,0 +1,8 @@
+"""``mx.mod`` — the legacy symbolic Module API (reference
+``python/mxnet/module/``)."""
+
+from .base_module import BaseModule, BatchEndParam
+from .bucketing_module import BucketingModule
+from .module import Module
+
+__all__ = ["BaseModule", "BatchEndParam", "BucketingModule", "Module"]

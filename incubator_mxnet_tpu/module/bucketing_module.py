@@ -1,0 +1,159 @@
+"""BucketingModule — variable-length sequence training.
+
+Capability parity with reference ``python/mxnet/module/bucketing_module.py``:
+``sym_gen(bucket_key) -> (symbol, data_names, label_names)``; one compiled
+executor per bucket, all buckets sharing the same parameter arrays.
+
+TPU-native redesign: the reference shares executor memory between bucketed
+symbols via ``shared_module`` binding. Under XLA each bucket is its own
+static-shape compiled program (per-bucket jit cache — exactly the
+"per-bucket compiled variants" plan of SURVEY §7); sharing is by binding
+every bucket's executor to the SAME NDArray parameter buffers, so an
+update through any bucket is visible to all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base_module import BaseModule
+from .module import Module, _as_shape_list
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, fixed_param_names=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+        self._bind_args = {}
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _make_module(self, bucket_key) -> Module:
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names=data_names,
+                      label_names=label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._bind_args = dict(for_training=for_training,
+                               inputs_need_grad=inputs_need_grad,
+                               grad_req=grad_req)
+        self.for_training = for_training
+        module = self._make_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, **self._bind_args)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        self._curr_module.init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        master = self._buckets[self._default_bucket_key]
+        master.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                              optimizer_params=optimizer_params,
+                              force_init=force_init)
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Select (lazily building + binding) the bucket's module; its
+        executor shares the master module's parameter/grad/aux buffers."""
+        assert self.binded, "call bind before switch_bucket"
+        master = self._buckets[self._default_bucket_key]
+        if bucket_key not in self._buckets:
+            module = self._make_module(bucket_key)
+            module.bind(data_shapes, label_shapes, **self._bind_args)
+            # share parameters: rebind arg/grad/aux slots to the master's
+            # NDArray objects so every bucket reads/writes one set of
+            # buffers (reference shared_module memory sharing)
+            for name in module._param_names:
+                if name in master._exec.arg_dict:
+                    module._exec.arg_dict[name] = master._exec.arg_dict[name]
+                    if (name in module._exec.grad_dict
+                            and name in master._exec.grad_dict):
+                        module._exec.grad_dict[name] = \
+                            master._exec.grad_dict[name]
+            for name in list(module._exec.aux_dict):
+                if name in master._exec.aux_dict:
+                    module._exec.aux_dict[name] = master._exec.aux_dict[name]
+            module.params_initialized = True
+            # optimizer state lives on the master; shared updater
+            module._optimizer = master._optimizer
+            module._updater = master._updater
+            module._kvstore = master._kvstore
+            module._update_on_kvstore = master._update_on_kvstore
+            module.optimizer_initialized = master.optimizer_initialized
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._default_bucket_key
+        data_shapes = (data_batch.provide_data
+                       or [(n, v.shape) for n, v in
+                           zip(self._curr_module.data_names,
+                               data_batch.data)])
+        label_shapes = (data_batch.provide_label
+                        or ([(n, v.shape) for n, v in
+                             zip(self._curr_module.label_names,
+                                 data_batch.label)]
+                            if data_batch.label is not None else None))
+        self.switch_bucket(key, data_shapes, label_shapes)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        self._curr_module.update()
+
+    def get_outputs(self):
+        return self._curr_module.get_outputs()
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def set_params(self, arg_params, aux_params, **kwargs):
+        self._buckets[self._default_bucket_key].set_params(
+            arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)

@@ -1,0 +1,293 @@
+"""Module — the symbolic trainer.
+
+Capability parity with reference ``python/mxnet/module/module.py``:
+bind → init_params → init_optimizer → forward/backward/update with kvstore
+semantics (`update_on_kvstore`), checkpointing (`prefix-symbol.json` +
+`prefix-%04d.params`), get/set_params.
+
+TPU-native redesign: the reference binds one executor per device and
+slices each batch over a ``DataParallelExecutorGroup``; here a single
+jitted executor serves the host and data parallelism is the SPMD mesh's
+job (parallel/spmd.py), so a context list is accepted for API parity but
+execution is one XLA program.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import initializer as init_mod
+from .. import kvstore as kvstore_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..device import current_context
+from ..io import DataDesc
+from ..ndarray.ndarray import NDArray
+from ..symbol.symbol import Symbol
+from .base_module import BaseModule
+
+
+def _as_shape_list(shapes) -> List[Tuple[str, tuple]]:
+    if shapes is None:
+        return []
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append((s.name, tuple(s.shape)))
+        else:
+            name, shape = s[0], s[1]
+            out.append((name, tuple(shape)))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol: Symbol, data_names: Sequence[str] = ("data",),
+                 label_names: Optional[Sequence[str]] = ("softmax_label",),
+                 logger=None, context=None, work_load_list=None,
+                 fixed_param_names: Optional[Sequence[str]] = None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = (context[0] if isinstance(context, (list, tuple))
+                         and context else context) or current_context()
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [
+            n for n in arg_names
+            if n not in self._data_names and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._grad_req = "write"
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def symbol(self) -> Symbol:
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in zip(self.output_names,
+                                             self._exec.outputs)]
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = _as_shape_list(data_shapes)
+        self._label_shapes = _as_shape_list(label_shapes)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req if for_training else "null"
+        shapes = dict(self._data_shapes + self._label_shapes)
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._fixed_param_names:
+                req[name] = "null"
+            elif name in self._data_names:
+                req[name] = ("write" if inputs_need_grad else "null")
+            elif name in self._label_names:
+                req[name] = "null"
+            else:
+                req[name] = self._grad_req
+        old_exec = self._exec
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context,
+            grad_req=req if for_training else "null", **shapes)
+        if old_exec is not None and self.params_initialized:
+            # re-bind (e.g. new shapes) keeps the trained parameters
+            self._exec.copy_params_from(
+                {k: old_exec.arg_dict[k] for k in self._param_names},
+                dict(old_exec.aux_dict), allow_extra_params=True)
+        self.binded = True
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and getattr(self, "_preloaded_params", None):
+            arg_params, aux_params = self._preloaded_params
+        initializer = initializer or init_mod.Uniform(0.01)
+        import jax.numpy as jnp
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            src = (arg_params or {}).get(name)
+            if src is not None:
+                arr._set_data(jnp.asarray(
+                    src.asnumpy() if isinstance(src, NDArray) else src,
+                    arr.dtype))
+            elif arg_params is not None and not allow_missing:
+                raise RuntimeError(
+                    f"parameter {name!r} missing from arg_params "
+                    "(pass allow_missing=True to initialize it)")
+            elif initializer is not None:
+                arr._set_data(jnp.asarray(
+                    initializer(name, arr.shape, arr.dtype)))
+            # initializer=None (set_params path): keep the current value
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            src = (aux_params or {}).get(name)
+            if src is not None:
+                arr._set_data(jnp.asarray(
+                    src.asnumpy() if isinstance(src, NDArray) else src,
+                    arr.dtype))
+            else:
+                arr._set_data(jnp.asarray(
+                    initializer(name, arr.shape, arr.dtype)))
+        self.params_initialized = True
+
+    def get_params(self) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+        arg = {k: self._exec.arg_dict[k].copy() for k in self._param_names}
+        aux = {k: v.copy() for k, v in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        # param_idx2name lets per-index lr/wd multipliers resolve names
+        optimizer.idx2name = dict(enumerate(self._param_names))
+        self._optimizer = optimizer
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+            self._updater = opt_mod.get_updater(optimizer)
+        else:
+            kv = (kvstore if isinstance(kvstore, kvstore_mod.KVStore)
+                  else kvstore_mod.create(kvstore))
+            self._kvstore = kv
+            # single-process stores run the optimizer on the store
+            # (reference update_on_kvstore default for local/device)
+            self._update_on_kvstore = True
+            kv.set_optimizer(optimizer)
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._exec.arg_dict[name])
+        self.optimizer_initialized = True
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, value in zip(self._data_names, data_batch.data):
+            feeds[name] = value
+        if data_batch.label is not None:
+            for name, value in zip(self._label_names, data_batch.label):
+                feeds[name] = value
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply the optimizer (reference ``Module.update``): with a
+        kvstore, push grads / pull updated weights; otherwise run the
+        local updater per parameter."""
+        assert self.optimizer_initialized
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, out=self._exec.arg_dict[name])
+        else:
+            for i, name in enumerate(self._param_names):
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self) -> List[NDArray]:
+        return self._exec.outputs
+
+    def get_input_grads(self) -> List[NDArray]:
+        assert self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states: bool = False):
+        """reference ``Module.save_checkpoint``: ``prefix-symbol.json`` +
+        ``prefix-%04d.params`` (+ ``.states``)."""
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg, aux = self.get_params()
+        payload = {f"arg:{k}": v for k, v in arg.items()}
+        payload.update({f"aux:{k}": v for k, v in aux.items()})
+        nd.save(f"{prefix}-{epoch:04d}.params", payload)
+        if save_optimizer_states:
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.save_optimizer_states(
+                    f"{prefix}-{epoch:04d}.states")
+            elif self._updater is not None:
+                with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                    f.write(self._updater.get_states())
+
+    @staticmethod
+    def load_checkpoint(prefix: str, epoch: int):
+        """→ (symbol, arg_params, aux_params) (reference
+        ``mx.model.load_checkpoint``)."""
+        from ..symbol import load as sym_load
+
+        symbol = sym_load(f"{prefix}-symbol.json")
+        payload = nd.load(f"{prefix}-{epoch:04d}.params")
+        arg = {k[4:]: v for k, v in payload.items() if k.startswith("arg:")}
+        aux = {k[4:]: v for k, v in payload.items() if k.startswith("aux:")}
+        return symbol, arg, aux
+
+    @classmethod
+    def load(cls, prefix: str, epoch: int, load_optimizer_states=False,
+             **kwargs):
+        symbol, arg, aux = cls.load_checkpoint(prefix, epoch)
+        mod = cls(symbol, **kwargs)
+        mod._preloaded_params = (arg, aux)
+        mod._preloaded_states = (f"{prefix}-{epoch:04d}.states"
+                                 if load_optimizer_states else None)
+        return mod

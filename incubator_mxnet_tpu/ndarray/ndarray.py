@@ -110,7 +110,8 @@ def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
     outs = [NDArray(o, ctx=ctx) for o in outs_raw]
 
     if recording:
-        autograd.record_op(vjp_fn, in_nd, outs, name=name, pure_fn=pure)
+        autograd.record_op(vjp_fn, in_nd, outs, name=name, pure_fn=pure,
+                           pure_tuple=not single)
     if is_naive_engine():
         for o in outs:
             o._data.block_until_ready()

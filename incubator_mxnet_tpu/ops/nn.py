@@ -12,6 +12,7 @@ assignment maps it to the TPU-preferred tiling internally.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -365,10 +366,63 @@ def softmax_cross_entropy(logits, label):
     return jnp.sum(nll)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         multi_output, normalization):
+    # multi_output: class axis is 1 (per-position softmax over (n, c, d…))
+    return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization):
+    p = jax.nn.softmax(data, axis=1 if multi_output else -1)
+    return p, (p, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        normalization, res, g):
+    # Reference src/operator/softmax_output.cc loss-op semantics: backward
+    # emits the cross-entropy gradient (p - onehot(label)) directly, treating
+    # the head gradient as 1 (g is intentionally unused) — this is what lets
+    # Module.backward() run with no explicit loss node.
+    del g
+    p, label = res
+    axis = 1 if multi_output else -1
+    classes = p.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, classes, dtype=p.dtype, axis=axis)
+    grad = p - onehot
+    if use_ignore:
+        valid = (lab != int(ignore_label)).astype(p.dtype)
+        grad = grad * jnp.expand_dims(valid, axis)
+    if normalization == "batch":
+        grad = grad / p.shape[0]
+    elif normalization == "valid":
+        if use_ignore:
+            n = jnp.maximum(jnp.sum(lab != int(ignore_label)), 1)
+        else:
+            n = lab.size
+        grad = grad / jnp.asarray(n, p.dtype)
+    if jnp.issubdtype(label.dtype, jnp.floating):
+        lab_ct = jnp.zeros_like(label)
+    else:
+        import numpy as _onp
+        lab_ct = _onp.zeros(label.shape, dtype=jax.dtypes.float0)
+    return (grad * grad_scale, lab_ct)
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput", aliases=("softmax_output",))
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
                    use_ignore=False, multi_output=False, normalization="null"):
-    return jax.nn.softmax(data, axis=-1)
+    """Output layer + implicit CE loss (reference
+    src/operator/softmax_output.cc): forward is softmax(data); backward is
+    the cross-entropy gradient wrt data given integer ``label``."""
+    return _softmax_output_core(data, label, float(grad_scale),
+                                int(ignore_label), bool(use_ignore),
+                                bool(multi_output), str(normalization))
 
 
 # ---------------------------------------------------------------------------

@@ -236,6 +236,29 @@ def reshape(x, shape=None):
     return jnp.reshape(x, shape)
 
 
+@register("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    """Reference src/operator/tensor/matrix_op.cc SliceAxis."""
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice", aliases=("crop",))
+def slice_op(x, begin=None, end=None, step=None):
+    """Reference Slice: multi-axis begin/end/step (None = full extent)."""
+    begin = begin or ()
+    end = end or ()
+    step = step or ()
+    idx = []
+    for i in range(x.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] is not None else None
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
 @register("transpose")
 def transpose(x, axes=None):
     return jnp.transpose(x, axes)

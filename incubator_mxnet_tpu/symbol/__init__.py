@@ -1,0 +1,36 @@
+"""``mx.sym`` / ``mx.symbol`` — symbolic graph namespace.
+
+Reference ``python/mxnet/symbol/``: op constructors are code-generated from
+the registry at import, plus Variable/Group/load. Here the constructors are
+made on demand via module ``__getattr__`` (PEP 562) over the same pure-jax
+op registry that powers ``mx.nd``.
+"""
+
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from ..ops import tensor as _t  # noqa: F401  ensure registration
+from ..ops import nn as _nn  # noqa: F401
+from ..ops import random_ops as _r  # noqa: F401
+from .symbol import (Group, Symbol, Variable, load, load_json, make_op, var,
+                     _name_manager)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_cache = {}
+
+
+def __getattr__(name: str):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    fn = _cache.get(name)
+    if fn is None:
+        if _registry.get(name) is None:
+            raise AttributeError(f"module 'symbol' has no op {name!r}")
+        fn = make_op(name)
+        _cache[name] = fn
+    return fn
+
+
+def __dir__():
+    return sorted(set(list(globals()) + _registry.list_ops()))

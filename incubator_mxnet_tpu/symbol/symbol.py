@@ -1,0 +1,718 @@
+"""Symbolic graph construction — the ``mx.sym`` world.
+
+Capability parity with reference ``python/mxnet/symbol/symbol.py`` +
+``src/nnvm/`` (Symbol composition, ``list_arguments``/``list_outputs``/
+``list_auxiliary_states``, ``infer_shape``/``infer_type``, JSON
+save/load, ``bind``/``simple_bind`` → Executor).
+
+TPU-native redesign: the reference Symbol is a handle into the C++ nnvm
+graph; graph passes (shape/type inference, memory planning, gradient) run
+natively and the executor pushes per-op engine work. Here a Symbol is a
+lightweight Python DAG over the SAME pure-jax op registry the imperative
+world uses (``ops.registry``): evaluation is one traced interpreter pass
+that jax.jit compiles into a single fused XLA computation — the analog of
+simple_bind's "plan once, execute many" — and gradients come from jax.vjp
+of that interpreter instead of an FGradient table. Shape/type inference is
+jax.eval_shape (abstract interpretation) plus a small per-op table for
+inferring auto-created parameter shapes (the bidirectional-FInferShape
+analog, forward-only).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import registry as _registry
+
+
+# ---------------------------------------------------------------------------
+# graph nodes
+# ---------------------------------------------------------------------------
+class _Node:
+    """One vertex: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]], num_outputs: int = 1):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+
+class _NameManager:
+    """Auto-naming (reference ``mx.name.NameManager``): fullyconnected0…"""
+
+    def __init__(self):
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def get(self, hint: str) -> str:
+        with self._lock:
+            idx = self._counters.get(hint, 0)
+            self._counters[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+
+
+_name_manager = _NameManager()
+
+
+# ---------------------------------------------------------------------------
+# per-op symbolic metadata
+# ---------------------------------------------------------------------------
+# aux inputs (reference "auxiliary states": mutated by forward, not trained)
+_AUX_INPUTS: Dict[str, Tuple[str, ...]] = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+}
+
+# optional inputs and the attr-condition under which they exist
+_OPTIONAL_INPUTS: Dict[str, Dict[str, Any]] = {
+    "FullyConnected": {"bias": lambda a: not a.get("no_bias", False)},
+    "Convolution": {"bias": lambda a: not a.get("no_bias", False)},
+    "Deconvolution": {"bias": lambda a: not a.get("no_bias", False)},
+    "LeakyReLU": {"gamma": lambda a: a.get("act_type") == "prelu"},
+}
+
+# number of symbol outputs when not 1
+_NUM_OUTPUTS: Dict[str, Any] = {
+    "split": lambda a: int(a.get("num_outputs", 2)),
+    "split_v2": lambda a: int(a.get("num_outputs", 2)),
+    "SliceChannel": lambda a: int(a.get("num_outputs", 2)),
+}
+
+# parameter-shape inference from the FIRST (data) input's shape — the
+# forward slice of the reference's bidirectional FInferShape needed to
+# materialize auto-created weight/bias/aux variables.
+def _fc_shapes(dshape, a):
+    nh = int(a["num_hidden"])
+    in_units = (int(np.prod(dshape[1:])) if a.get("flatten", True)
+                else dshape[-1])
+    out = {"weight": (nh, in_units)}
+    if not a.get("no_bias", False):
+        out["bias"] = (nh,)
+    return out
+
+
+def _conv_shapes(dshape, a):
+    nf = int(a["num_filter"])
+    kernel = a["kernel"]
+    kernel = (kernel,) if isinstance(kernel, int) else tuple(kernel)
+    g = int(a.get("num_group", 1))
+    out = {"weight": (nf, dshape[1] // g) + kernel}
+    if not a.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _deconv_shapes(dshape, a):
+    nf = int(a["num_filter"])
+    kernel = a["kernel"]
+    kernel = (kernel,) if isinstance(kernel, int) else tuple(kernel)
+    g = int(a.get("num_group", 1))
+    out = {"weight": (dshape[1], nf // g) + kernel}
+    if not a.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _bn_shapes(dshape, a):
+    c = dshape[a.get("axis", 1)]
+    return {"gamma": (c,), "beta": (c,),
+            "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _ln_shapes(dshape, a):
+    c = dshape[a.get("axis", -1)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _in_shapes(dshape, a):
+    return {"gamma": (dshape[1],), "beta": (dshape[1],)}
+
+
+def _emb_shapes(dshape, a):
+    return {"weight": (int(a["input_dim"]), int(a["output_dim"]))}
+
+
+def _prelu_shapes(dshape, a):
+    if a.get("act_type") == "prelu":
+        return {"gamma": (dshape[1],)}
+    return {}
+
+
+_PARAM_SHAPE_INFER = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _bn_shapes,
+    "LayerNorm": _ln_shapes,
+    "InstanceNorm": _in_shapes,
+    "GroupNorm": _in_shapes,
+    "RMSNorm": lambda d, a: {"gamma": (d[a.get("axis", -1)],)},
+    "Embedding": _emb_shapes,
+    "LeakyReLU": _prelu_shapes,
+}
+
+
+def _op_input_params(opdef) -> Tuple[List[str], List[str], bool]:
+    """(required_inputs, optional_params, is_variadic) from the signature.
+
+    Required = positional parameters without defaults (pure-jax ops list
+    array inputs first). Optional inputs only exist via _OPTIONAL_INPUTS.
+    Variadic = *arrays ops like concat/stack.
+    """
+    import inspect
+
+    sig = inspect.signature(opdef.fn)
+    required, optional, variadic = [], [], False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            variadic = True
+            continue
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            continue
+        if p.default is inspect.Parameter.empty:
+            required.append(p.name)
+        else:
+            optional.append(p.name)
+    return required, optional, variadic
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+class Symbol:
+    """A handle on one or more output entries of the symbolic graph."""
+
+    def __init__(self, entries: List[Tuple[_Node, int]]):
+        self._entries = entries
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._entries) != 1:
+            return "grouped"
+        node, idx = self._entries[0]
+        if node.num_outputs > 1 and not node.is_variable:
+            return f"{node.name}_output{idx}"
+        return node.name
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # -- attributes ---------------------------------------------------------
+    def attr(self, key: str):
+        node = self._entries[0][0]
+        v = node.attrs.get(key)
+        return None if v is None else str(v)
+
+    def list_attr(self) -> Dict[str, str]:
+        node = self._entries[0][0]
+        return {k: str(v) for k, v in node.attrs.items()}
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0].attrs.update(kwargs)
+
+    # -- traversal ----------------------------------------------------------
+    def _topo_nodes(self) -> List[_Node]:
+        seen: Dict[int, _Node] = {}
+        order: List[_Node] = []
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for parent, _ in node.inputs:
+                visit(parent)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        aux = set(self._aux_node_names())
+        return [n.name for n in self._topo_nodes()
+                if n.is_variable and n.name not in aux]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._entries:
+            if node.is_variable:
+                outs.append(node.name)
+            elif node.num_outputs > 1:
+                outs.append(f"{node.name}_output{idx}")
+            else:
+                outs.append(f"{node.name}_output")
+        return outs
+
+    def _aux_node_names(self) -> List[str]:
+        names = []
+        for n in self._topo_nodes():
+            if n.is_variable or n.op not in _AUX_INPUTS:
+                continue
+            for (parent, _pi), pname in zip(n.inputs, self._input_param_names(n)):
+                if pname in _AUX_INPUTS[n.op] and parent.is_variable:
+                    names.append(parent.name)
+        return names
+
+    @staticmethod
+    def _input_param_names(node: _Node) -> List[str]:
+        """Parameter names corresponding to node.inputs, in order."""
+        opdef = _registry.get(node.op)
+        req, _opt, variadic = _op_input_params(opdef)
+        if variadic and not req:
+            return [f"arg{i}" for i in range(len(node.inputs))]
+        names = list(req)
+        extra = _OPTIONAL_INPUTS.get(node.op, {})
+        for pname, cond in extra.items():
+            if (cond(node.attrs) if callable(cond) else cond):
+                names.append(pname)
+        # optional inputs the user passed explicitly (recorded at build time)
+        names += [n for n in node.attrs.get("__extra_inputs__", ())
+                  if n not in names]
+        return names[:len(node.inputs)] + [
+            f"in{i}" for i in range(len(names), len(node.inputs))]
+
+    def list_auxiliary_states(self) -> List[str]:
+        seen, out = set(), []
+        for n in self._aux_node_names():
+            if n not in seen:
+                seen.add(n)
+                out.append(n)
+        return out
+
+    def get_internals(self) -> "Symbol":
+        """All intermediate outputs as a group (reference
+        ``Symbol.get_internals``; used for feature extraction and
+        SymbolBlock surgery)."""
+        entries = []
+        for n in self._topo_nodes():
+            for i in range(n.num_outputs if not n.is_variable else 1):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index!r}: {names}")
+            index = names.index(index)
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binary(self, other, op, rop=None, scalar_op=None):
+        if isinstance(other, Symbol):
+            return _apply_op(op, [self, other], {}, None)
+        return _apply_op(scalar_op, [self], {"scalar": float(other)}, None)
+
+    def __add__(self, other):
+        return self._binary(other, "add", scalar_op="_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "subtract", scalar_op="_minus_scalar")
+
+    def __rsub__(self, other):
+        return _apply_op("_rminus_scalar", [self],
+                         {"scalar": float(other)}, None)
+
+    def __mul__(self, other):
+        return self._binary(other, "multiply", scalar_op="_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "divide", scalar_op="_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _apply_op("_rdiv_scalar", [self],
+                         {"scalar": float(other)}, None)
+
+    def __pow__(self, other):
+        return self._binary(other, "power", scalar_op="_power_scalar")
+
+    def __neg__(self):
+        return _apply_op("negative", [self], {}, None)
+
+    def __abs__(self):
+        return _apply_op("abs", [self], {}, None)
+
+    # -- inference ----------------------------------------------------------
+    def infer_shape(self, **known) -> Tuple[List, List, List]:
+        a, o, x = self._infer_shape_impl(known, partial=False)
+        return a, o, x
+
+    def infer_shape_partial(self, **known):
+        return self._infer_shape_impl(known, partial=True)
+
+    def _infer_shape_impl(self, known, partial):
+        import jax
+
+        shapes: Dict[str, Optional[Tuple[int, ...]]] = {}
+        for n in self._topo_nodes():
+            if not n.is_variable:
+                continue
+            if n.name in known:
+                shapes[n.name] = tuple(known[n.name])
+            elif "__shape__" in n.attrs:
+                shapes[n.name] = tuple(n.attrs["__shape__"])
+            else:
+                shapes[n.name] = None
+
+        node_out_shapes: Dict[Tuple[int, int], Optional[Tuple]] = {}
+
+        def entry_shape(node, idx):
+            if node.is_variable:
+                return shapes.get(node.name)
+            return node_out_shapes.get((id(node), idx))
+
+        for n in self._topo_nodes():
+            if n.is_variable:
+                continue
+            pnames = self._input_param_names(n)
+            # fill unknown parameter-variable shapes from the data input
+            data_shape = (entry_shape(*n.inputs[0]) if n.inputs else None)
+            infer = _PARAM_SHAPE_INFER.get(n.op)
+            if infer is not None and data_shape is not None:
+                pshapes = infer(data_shape, n.attrs)
+                for (parent, _pi), pname in zip(n.inputs, pnames):
+                    if (parent.is_variable and shapes.get(parent.name) is None
+                            and pname in pshapes):
+                        shapes[parent.name] = tuple(pshapes[pname])
+            in_shapes = [entry_shape(p, i) for p, i in n.inputs]
+            if any(s is None for s in in_shapes):
+                continue  # cannot evaluate this node yet
+            # abstract-evaluate the op to get output shapes
+            opdef = _registry.get(n.op)
+            kwargs = {k: v for k, v in n.attrs.items()
+                      if not k.startswith("__")}
+            specs = [jax.ShapeDtypeStruct(s, np.float32) for s in in_shapes]
+            try:
+                out = jax.eval_shape(
+                    lambda *xs: _call_node_fn(opdef, n, xs, kwargs,
+                                              is_train=False, rng=None),
+                    *specs)
+            except Exception:
+                if partial:
+                    continue
+                raise
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                node_out_shapes[(id(n), i)] = tuple(o.shape)
+
+        arg_shapes = [shapes.get(a) for a in self.list_arguments()]
+        aux_shapes = [shapes.get(a) for a in self.list_auxiliary_states()]
+        out_shapes = [entry_shape(n, i) for n, i in self._entries]
+        if not partial:
+            missing = [a for a, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            if missing or any(s is None for s in out_shapes):
+                raise ValueError(
+                    f"infer_shape incomplete; unknown: {missing}; provide "
+                    "shapes for the data variables (forward-only inference)")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **known):
+        """Forward dtype inference; defaults every unspecified leaf to
+        float32 (reference behavior for NN graphs)."""
+        args = self.list_arguments()
+        arg_types = [known.get(a, np.float32) for a in args]
+        out_types = [np.float32 for _ in self._entries]
+        aux_types = [np.float32 for _ in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
+
+    # -- evaluation ---------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """Eager evaluation with NDArray keyword bindings (reference
+        ``Symbol.eval``). Returns a list of NDArrays."""
+        from ..ndarray import NDArray
+
+        ex = self.bind(ctx, args={k: v for k, v in kwargs.items()})
+        return ex.forward(is_train=False)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args or {}, args_grad, grad_req,
+                        aux_states or {})
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        """Infer shapes, allocate argument/gradient/aux arrays, return a
+        ready Executor (reference ``Symbol.simple_bind``)."""
+        from ..executor import Executor
+        from ..ndarray import ndarray as _nd
+
+        import jax.numpy as jnp
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        type_dict = type_dict or {}
+        args = {}
+        for name, shp in zip(self.list_arguments(), arg_shapes):
+            dt = type_dict.get(name, np.float32)
+            args[name] = _nd.NDArray(jnp.zeros(shp, dt), ctx=ctx)
+        aux = {}
+        for name, shp in zip(self.list_auxiliary_states(), aux_shapes):
+            aux[name] = _nd.NDArray(jnp.zeros(shp, np.float32), ctx=ctx)
+        def req_of(name):
+            return (grad_req.get(name, "null")
+                    if isinstance(grad_req, dict) else grad_req)
+
+        args_grad = {
+            name: _nd.NDArray(jnp.zeros_like(args[name]._data), ctx=ctx)
+            for name in args if req_of(name) != "null"}
+        return Executor(self, ctx, args, args_grad or None, grad_req, aux)
+
+    # -- gradient -----------------------------------------------------------
+    def grad(self, wrt: Sequence[str]) -> "Symbol":
+        raise NotImplementedError(
+            "symbol.grad: use Executor.backward (jax.vjp of the bound "
+            "graph) — standalone gradient symbols are not materialized")
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            attrs = {k: (v if isinstance(v, str) else repr(v))
+                     for k, v in n.attrs.items()}
+            out_nodes.append({
+                "op": "null" if n.is_variable else n.op,
+                "name": n.name,
+                "attrs": attrs,
+                "inputs": [[nid[id(p)], i, 0] for p, i in n.inputs],
+                "num_outputs": n.num_outputs,
+            })
+        payload = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "heads": [[nid[id(n)], i, 0] for n, i in self._entries],
+            "attrs": {"framework": "incubator_mxnet_tpu",
+                      "json_version": 1},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+# ---------------------------------------------------------------------------
+# node evaluation helper (shared with executor)
+# ---------------------------------------------------------------------------
+def _call_node_fn(opdef, node: _Node, in_arrays, kwargs, is_train, rng):
+    """Call a registered op fn for a symbolic node."""
+    import inspect
+
+    kw = dict(kwargs)
+    kw.pop("__extra_inputs__", None)
+    sig = inspect.signature(opdef.fn)
+    if "training" in sig.parameters:
+        kw["training"] = bool(is_train)
+    if opdef.needs_rng:
+        kw["rng"] = rng
+    req, _opt, variadic = _op_input_params(opdef)
+    if variadic and not req:
+        return opdef.fn(*in_arrays, **kw)
+    # inputs bound by name so optional inputs land on the right parameter
+    pnames = Symbol._input_param_names(node)
+    pos = list(in_arrays[:len(req)])
+    for pname, arr in zip(pnames[len(req):], in_arrays[len(req):]):
+        kw[pname] = arr
+    return opdef.fn(*pos, **kw)
+
+
+# ---------------------------------------------------------------------------
+# construction surface
+# ---------------------------------------------------------------------------
+def var(name: str, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
+    """Create a symbolic variable (reference ``mx.sym.Variable``)."""
+    attrs = dict(kwargs)
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    return Symbol([(_Node(None, name, attrs, []), 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def _apply_op(op_name: str, sym_args: Sequence[Symbol],
+              kwargs: Dict[str, Any], name: Optional[str]) -> Symbol:
+    opdef = _registry.get(op_name)
+    if opdef is None:
+        raise AttributeError(f"unknown op {op_name!r}")
+    canonical = opdef.name
+    node_name = name or _name_manager.get(canonical.lower())
+
+    req, opt, variadic = _op_input_params(opdef)
+    # split kwargs into symbol inputs vs attrs
+    sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+    attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+    inputs: List[Tuple[_Node, int]] = []
+    if variadic:
+        for s in sym_args:
+            inputs.append(s._entries[0])
+    else:
+        slots: Dict[str, Symbol] = {}
+        for pname, s in zip(req, sym_args):
+            slots[pname] = s
+        if len(sym_args) > len(req):
+            raise TypeError(
+                f"{canonical} takes {len(req)} positional symbol inputs")
+        slots.update(sym_kwargs)
+        # which inputs exist for this node?
+        active = list(req)
+        for pname, cond in _OPTIONAL_INPUTS.get(canonical, {}).items():
+            if (cond(attrs) if callable(cond) else cond):
+                active.append(pname)
+        extra = [k for k in sym_kwargs
+                 if k not in active and k in opt]
+        if extra:
+            attrs["__extra_inputs__"] = tuple(extra)
+            active += extra
+        for pname in active:
+            s = slots.get(pname)
+            if s is None:
+                # auto-create a variable (reference auto-naming:
+                # {node}_weight, {node}_bias, …)
+                s = var(f"{node_name}_{pname}")
+            inputs.append(s._entries[0])
+
+    n_out = _NUM_OUTPUTS.get(canonical)
+    num_outputs = n_out(attrs) if callable(n_out) else (n_out or 1)
+    node = _Node(canonical, node_name, attrs, inputs, num_outputs)
+    if num_outputs == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(num_outputs)])
+
+
+def make_op(op_name: str):
+    """Symbolic constructor for a registered op (``mx.sym.<OpName>``)."""
+
+    def ctor(*args, name: Optional[str] = None, **kwargs):
+        sym_args = []
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise TypeError(
+                    f"sym.{op_name} positional args must be Symbols, got "
+                    f"{type(a)}; pass options as keywords")
+            sym_args.append(a)
+        return _apply_op(op_name, sym_args, kwargs, name)
+
+    ctor.__name__ = op_name
+    opdef = _registry.get(op_name)
+    ctor.__doc__ = opdef.doc if opdef else None
+    return ctor
+
+
+# ---------------------------------------------------------------------------
+# JSON load
+# ---------------------------------------------------------------------------
+def _parse_attr(v: str):
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load_json(json_str: str) -> Symbol:
+    payload = json.loads(json_str)
+    nodes: List[_Node] = []
+    for spec in payload["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
+        inputs = [(nodes[i], oi) for i, oi, _ in spec.get("inputs", [])]
+        op = None if spec["op"] == "null" else spec["op"]
+        if op is not None and _registry.get(op) is None:
+            raise ValueError(f"symbol JSON references unknown op {op!r}")
+        nodes.append(_Node(op, spec["name"], attrs, inputs,
+                           spec.get("num_outputs", 1)))
+    entries = [(nodes[i], oi) for i, oi, _ in payload["heads"]]
+    return Symbol(entries)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# scalar-arithmetic ops used by Symbol operator overloads (also reachable
+# from mx.nd.* — the reference registers the same _plus_scalar family)
+import jax.numpy as _jnp  # noqa: E402
+
+
+@_registry.register("_plus_scalar")
+def _plus_scalar(x, scalar=0.0):
+    return x + scalar
+
+
+@_registry.register("_minus_scalar")
+def _minus_scalar(x, scalar=0.0):
+    return x - scalar
+
+
+@_registry.register("_rminus_scalar")
+def _rminus_scalar(x, scalar=0.0):
+    return scalar - x
+
+
+@_registry.register("_mul_scalar")
+def _mul_scalar(x, scalar=1.0):
+    return x * scalar
+
+
+@_registry.register("_div_scalar")
+def _div_scalar(x, scalar=1.0):
+    return x / scalar
+
+
+@_registry.register("_rdiv_scalar")
+def _rdiv_scalar(x, scalar=1.0):
+    return scalar / x
+
+
+@_registry.register("_power_scalar")
+def _power_scalar(x, scalar=1.0):
+    return x ** scalar
+
+
+@_registry.register("_rpower_scalar")
+def _rpower_scalar(x, scalar=1.0):
+    return scalar ** x

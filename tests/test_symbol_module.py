@@ -1,0 +1,443 @@
+"""Symbolic world: Symbol composition/inference/JSON, Executor fwd/bwd,
+Module.fit, BucketingModule, SymbolBlock import (SURVEY.md §1 layer 4b,
+§2.2 symbol/executor/Module rows; reference python/mxnet/symbol/symbol.py,
+module/module.py, src/executor/graph_executor.cc)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+
+
+def _mlp_symbol(hidden=16, classes=3, with_bn=False):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    if with_bn:
+        net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+def test_symbol_arguments_and_auto_naming():
+    net = _mlp_symbol(with_bn=True)
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "bn1_gamma", "bn1_beta",
+        "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_auxiliary_states() == [
+        "bn1_moving_mean", "bn1_moving_var"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_infer_shape():
+    net = _mlp_symbol(hidden=16, classes=3, with_bn=True)
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(4, 8), softmax_label=(4,))
+    args = net.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (16, 8)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (3, 16)
+    assert out_shapes == [(4, 3)]
+    assert aux_shapes == [(16,), (16,)]
+
+
+def test_symbol_infer_shape_conv():
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv0")
+    net = mx.sym.BatchNorm(net, name="bn0")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool0")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv0_weight"] == (8, 3, 3, 3)
+    assert d["conv0_bias"] == (8,)
+    assert d["bn0_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+
+
+def test_symbol_incomplete_infer_raises():
+    net = _mlp_symbol()
+    with pytest.raises(ValueError):
+        net.infer_shape()  # no data shape given
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp_symbol(with_bn=True)
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_auxiliary_states() == net.list_auxiliary_states()
+    assert net2.list_outputs() == net.list_outputs()
+    # attrs survive (num_hidden on fc nodes)
+    a1, o1, _ = net.infer_shape(data=(2, 5), softmax_label=(2,))
+    a2, o2, _ = net2.infer_shape(data=(2, 5), softmax_label=(2,))
+    assert a1 == a2 and o1 == o2
+
+
+def test_symbol_arithmetic_and_group():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b) * 2.0 - a / 4.0
+    ex = c.bind(args={"a": mx.nd.array([2.0]), "b": mx.nd.array([3.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [(2 + 3) * 2 - 2 / 4])
+    g = mx.sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    outs = g.bind(args={"a": mx.nd.array([2.0]),
+                        "b": mx.nd.array([3.0])}).forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [6.0])
+
+
+def test_symbol_get_internals():
+    net = _mlp_symbol()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    assert feat.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_multi_output_split():
+    data = mx.sym.var("data")
+    parts = mx.sym.split(data, num_outputs=2, axis=1, name="sp")
+    assert len(parts.list_outputs()) == 2
+    ex = parts.bind(args={"data": mx.nd.array(np.arange(8).reshape(2, 4))})
+    o0, o1 = ex.forward()
+    assert o0.shape == (2, 2) and o1.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+def test_executor_forward_backward_matches_autograd():
+    np.random.seed(0)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    ex = net.simple_bind(grad_req="write", data=(3, 5))
+    x = np.random.randn(3, 5).astype(np.float32)
+    w = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    ex.arg_dict["fc_weight"]._set_data(mx.nd.array(w)._data)
+    ex.arg_dict["fc_bias"]._set_data(mx.nd.array(b)._data)
+    out = ex.forward(is_train=True, data=x)[0]
+    expect = np.tanh(x @ w.T + b)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+    ex.backward()  # head grad ones
+    # autograd oracle on the imperative world
+    xs = mx.nd.array(x)
+    ws, bs = mx.nd.array(w), mx.nd.array(b)
+    for t in (xs, ws, bs):
+        t.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.tanh(mx.nd.FullyConnected(xs, ws, bs, num_hidden=4))
+    y.backward()
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               ws.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               xs.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_executor_grad_req_add_and_null():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, no_bias=True, name="fc")
+    ex = net.simple_bind(grad_req={"fc_weight": "add", "data": "null"},
+                        data=(2, 3))
+    ex.arg_dict["fc_weight"]._set_data(mx.nd.ones((2, 3))._data)
+    x = np.ones((2, 3), np.float32)
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    g1 = ex.grad_dict["fc_weight"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    g2 = ex.grad_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1)  # accumulated
+    assert "data" not in ex.grad_dict or \
+        np.all(ex.grad_dict["data"].asnumpy() == 0)
+
+
+def test_executor_batchnorm_aux_updates_only_in_train():
+    net = mx.sym.BatchNorm(mx.sym.var("data"), momentum=0.5, name="bn")
+    ex = net.simple_bind(grad_req="null", data=(8, 4))
+    ex.aux_dict["bn_moving_var"]._set_data(mx.nd.ones((4,))._data)
+    ex.arg_dict["bn_gamma"]._set_data(mx.nd.ones((4,))._data)
+    x = np.random.randn(8, 4).astype(np.float32) * 3 + 1
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+    ex.forward(is_train=True, data=x)
+    expect = 0.5 * mm0 + 0.5 * x.mean(0)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               expect, rtol=1e-4)
+
+
+def test_symbol_eval():
+    a = mx.sym.var("a")
+    out = (a * 3.0).eval(a=mx.nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(out[0].asnumpy(), [3.0, 6.0])
+
+
+# ---------------------------------------------------------------------------
+# Module
+# ---------------------------------------------------------------------------
+def _toy_problem(n=600, d=20, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(d, classes)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ W).argmax(1).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_mnist_style():
+    """BASELINE config[0]-style: Module.fit on a small classification
+    problem converges (reference Module.fit + NDArrayIter)."""
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+    mod = mx.mod.Module(_mlp_symbol(hidden=64))
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01}, num_epoch=15)
+    assert mod.score(val, "acc")[0][1] > 0.9
+
+
+def test_module_forward_backward_update_loop():
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(hidden=32, with_bn=True))
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("ce")
+    losses = []
+    for epoch in range(4):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        losses.append(metric.get()[1])
+    assert losses[-1] < losses[0]
+
+
+def test_module_predict_and_outputs():
+    X, y = _toy_problem(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)  # pads last batch
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (100, 3)  # pad removed
+    np.testing.assert_allclose(preds.asnumpy().sum(1), 1.0, rtol=1e-4)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    X, y = _toy_problem(n=200)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_symbol(hidden=8))
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 3)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in arg
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    mod2.init_params()
+    mod.forward(next(iter(it)), is_train=False)
+    it.reset()
+    mod2.forward(next(iter(it)), is_train=False)
+    np.testing.assert_allclose(mod2.get_outputs()[0].asnumpy(),
+                               mod.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_fixed_params():
+    X, y = _toy_problem(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_symbol(hidden=8),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    w0 = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    np.testing.assert_array_equal(
+        mod._exec.arg_dict["fc1_weight"].asnumpy(), w0)
+    assert not np.allclose(mod._exec.arg_dict["fc2_weight"].asnumpy(),
+                           mod._exec.arg_dict["fc2_weight"].asnumpy() * 0
+                           + w0.mean())
+
+
+# ---------------------------------------------------------------------------
+# BucketingModule: variable-length RNN (reference char-rnn pattern)
+# ---------------------------------------------------------------------------
+def _rnn_sym_gen(num_hidden=16, dim=8, classes=4):
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")          # (B, T, D)
+        label = mx.sym.var("softmax_label")
+        wx = mx.sym.var("rnn_i2h_weight")  # shared across time steps
+        wh = mx.sym.var("rnn_h2h_weight")
+        h = None
+        for t in range(seq_len):
+            xt = mx.sym.slice_axis(data, axis=1, begin=t, end=t + 1,
+                                   name=f"slice{t}")
+            xt = mx.sym.reshape(xt, shape=(-1, dim), name=f"resh{t}")
+            i2h = mx.sym.FullyConnected(xt, weight=wx, num_hidden=num_hidden,
+                                        no_bias=True, name=f"i2h{t}")
+            if h is not None:
+                h2h = mx.sym.FullyConnected(h, weight=wh,
+                                            num_hidden=num_hidden,
+                                            no_bias=True, name=f"h2h{t}")
+                i2h = i2h + h2h
+            h = mx.sym.Activation(i2h, act_type="tanh", name=f"act{t}")
+        net = mx.sym.FullyConnected(h, num_hidden=classes, name="out_fc")
+        net = mx.sym.SoftmaxOutput(net, label=label, name="softmax",
+                                   normalization="batch")
+        return net, ("data",), ("softmax_label",)
+
+    return sym_gen
+
+
+def test_bucketing_module_variable_length_rnn():
+    np.random.seed(0)
+    dim, classes = 8, 4
+    buckets = [3, 5]
+    mod = mx.mod.BucketingModule(_rnn_sym_gen(dim=dim, classes=classes),
+                                 default_bucket_key=max(buckets))
+    B = 16
+    mod.bind(data_shapes=[("data", (B, max(buckets), dim))],
+             label_shapes=[("softmax_label", (B,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+
+    # learnable toy task: label = argmax of the mean over time of x
+    def make_batch(T):
+        x = np.random.randn(B, T, dim).astype(np.float32)
+        yy = x.mean(1)[:, :classes].argmax(1).astype(np.float32)
+        return mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(yy)], bucket_key=T,
+            provide_data=[("data", (B, T, dim))],
+            provide_label=[("softmax_label", (B,))])
+
+    metric = mx.metric.create("ce")
+    losses = []
+    for step in range(60):
+        batch = make_batch(buckets[step % 2])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        metric.reset()
+        mod.update_metric(metric, batch.label)
+        losses.append(metric.get()[1])
+    # trained across BOTH buckets with shared params: loss must drop
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+    # both bucket executors exist and share the same weight buffer
+    m3, m5 = mod._buckets[3], mod._buckets[5]
+    assert m3._exec.arg_dict["rnn_i2h_weight"] is \
+        m5._exec.arg_dict["rnn_i2h_weight"]
+
+
+# ---------------------------------------------------------------------------
+# SymbolBlock
+# ---------------------------------------------------------------------------
+def test_symbolblock_imports_and_matches_module(tmp_path):
+    np.random.seed(0)
+    X, y = _toy_problem(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_symbol(hidden=8, with_bn=True))
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "deploy")
+    mod.save_checkpoint(prefix, 0)
+
+    # strip the label-consuming loss head for deployment (reference
+    # get_internals surgery), then import as a Gluon block
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    feat = sym.get_internals()["fc2_output"]
+    blk = gluon.SymbolBlock(feat, [mx.sym.var("data")])
+    blk.initialize()
+    params = {n: p for n, p in blk._reg_params.items()}
+    import jax.numpy as jnp
+    for n, p in params.items():
+        src = arg.get(n, aux.get(n))
+        p.shape = tuple(src.shape)
+        p._finish_deferred_init(p.shape)
+        p.data()._set_data(jnp.asarray(src.asnumpy()))
+
+    x = mx.nd.array(X[:50])
+    out_blk = blk(x).asnumpy()
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    # module output is softmax(fc2); apply softmax to block logits
+    out_mod = mod.get_outputs()[0].asnumpy()
+    e = np.exp(out_blk - out_blk.max(1, keepdims=True))
+    np.testing.assert_allclose(e / e.sum(1, keepdims=True), out_mod,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_symbolblock_gradient_flows():
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fc")
+    blk = gluon.SymbolBlock(net, [mx.sym.var("data")])
+    blk.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(2, 6))
+    with mx.autograd.record():
+        loss = (blk(x) ** 2).sum()
+    loss.backward()
+    g = blk._reg_params["fc_weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_softmax_output_multi_output_axis():
+    """multi_output=True: class axis is 1, per-position CE grad."""
+    np.random.seed(0)
+    data = np.random.randn(2, 3, 4).astype(np.float32)
+    label = np.random.randint(0, 3, (2, 4)).astype(np.float32)
+    d = mx.nd.array(data)
+    d.attach_grad()
+    with mx.autograd.record():
+        p = mx.nd.SoftmaxOutput(d, mx.nd.array(label), multi_output=True)
+    np.testing.assert_allclose(p.asnumpy().sum(1), 1.0, rtol=1e-5)
+    p.backward()
+    sm = np.exp(data) / np.exp(data).sum(1, keepdims=True)
+    onehot = np.eye(3)[label.astype(int)].transpose(0, 2, 1)
+    np.testing.assert_allclose(d.grad.asnumpy(), sm - onehot,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_simple_bind_no_grad_buffers_for_null_req():
+    net = _mlp_symbol()
+    req = {n: ("write" if "weight" in n or "bias" in n else "null")
+           for n in net.list_arguments()}
+    ex = net.simple_bind(grad_req=req, data=(4, 8), softmax_label=(4,))
+    assert "data" not in ex.grad_dict
+    assert "softmax_label" not in ex.grad_dict
+    assert "fc1_weight" in ex.grad_dict
+
+
+def test_module_init_params_allow_missing_semantics():
+    X, y = _toy_problem(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_symbol(hidden=8))
+    mod.bind(it.provide_data, it.provide_label)
+    partial = {"fc1_weight": mx.nd.ones((8, 20))}
+    with pytest.raises(RuntimeError):
+        mod.init_params(arg_params=partial, allow_missing=False)
+    mod.init_params(arg_params=partial, allow_missing=True)
+    np.testing.assert_array_equal(
+        mod._exec.arg_dict["fc1_weight"].asnumpy(), np.ones((8, 20)))
+    # missing params were initialized, not left at zero
+    assert np.abs(mod._exec.arg_dict["fc2_weight"].asnumpy()).sum() > 0
