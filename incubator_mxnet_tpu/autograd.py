@@ -259,23 +259,46 @@ def _run_backward(heads, head_grads, variables=None, retain_graph=False,
                 if var_set is not None and id(recv) in var_set:
                     slot = var_set[id(recv)]
                     prev = var_grads.get(slot)
-                    var_grads[slot] = g if prev is None else prev + g
+                    var_grads[slot] = g if prev is None \
+                        else _add_cotangents(prev, g)
                 elif var_set is None:
                     _write_grad(recv, g, written)
             if edge is not None:
+                from .ndarray.sparse import BaseSparseNDArray
+
+                if isinstance(g, BaseSparseNDArray):
+                    # interior nodes differentiate with dense cotangents;
+                    # sparsity is a leaf-storage property (reference:
+                    # backward stype fallback densifies mid-graph)
+                    g = g.todense()._data
                 _accumulate((id(edge[0]), edge[1]), g)
 
     if variables is not None:
+        from .ndarray.sparse import RowSparseNDArray
+
         out = []
         for i, v in enumerate(variables):
             g = var_grads.get(i)
             if g is None:
                 g = jnp.zeros(v.shape, v.dtype)
             # keep NDArray results as-is: with create_graph=True they carry
-            # tape nodes that a second grad() call differentiates through
-            out.append(g if isinstance(g, NDArray) else NDArray(g, ctx=v.ctx))
+            # tape nodes that a second grad() call differentiates through;
+            # row-sparse cotangents stay row-sparse (reference grad_stype)
+            out.append(g if isinstance(g, (NDArray, RowSparseNDArray))
+                       else NDArray(g, ctx=v.ctx))
         return out
     return None
+
+
+def _add_cotangents(a, b):
+    """Sum two cotangents, either of which may be row-sparse."""
+    from .ndarray.sparse import BaseSparseNDArray
+    from .ndarray.sparse import add as _sparse_add
+
+    if isinstance(a, BaseSparseNDArray) or isinstance(b, BaseSparseNDArray):
+        out = _sparse_add(a, b)
+        return out if isinstance(out, BaseSparseNDArray) else out._data
+    return a + b
 
 
 def _apply_vjp(node: Node, cts: List[Any], create_graph: bool) -> Tuple:
@@ -341,19 +364,55 @@ def _write_grad(var, g, written: set) -> None:
     snapshots share buffers across distinct handles.
     """
     from .ndarray import NDArray
+    from .ndarray.sparse import RowSparseNDArray
 
-    if isinstance(g, NDArray):
-        g = g._data
     req = getattr(var, "_grad_req", "write")
     if req == "null" or var._grad is None:
         return
     buf_id = id(var._grad)
-    if req == "add" or buf_id in written:
-        var._grad._data = var._grad._data + g
-    else:
+    first_touch = req != "add" and buf_id not in written
+    if isinstance(g, RowSparseNDArray) or isinstance(var._grad,
+                                                     RowSparseNDArray):
+        _write_sparse_grad(var, g, first_touch)
+        written.add(buf_id)
+        var._grad_fresh = True
+        return
+    if isinstance(g, NDArray):
+        g = g._data
+    if first_touch:
         var._grad._data = jnp.asarray(g, var._grad.dtype)
         written.add(buf_id)
+    else:
+        var._grad._data = var._grad._data + g
     var._grad_fresh = True  # Trainer stale-grad detection (reference parity)
+
+
+def _write_sparse_grad(var, g, first_touch: bool) -> None:
+    """Row-sparse grad buffer writes (reference ``grad_stype='row_sparse'``):
+    rsp cotangent into rsp buffer replaces/merges; a dense cotangent into an
+    rsp buffer densifies the write via cast; rsp into dense scatters."""
+    from .ndarray import NDArray
+    from .ndarray.sparse import (RowSparseNDArray, cast_storage,
+                                 _merge_row_sparse)
+
+    grad_buf = var._grad
+    if isinstance(grad_buf, RowSparseNDArray):
+        if not isinstance(g, RowSparseNDArray):
+            g = cast_storage(NDArray(g._data if isinstance(g, NDArray)
+                                     else g), "row_sparse")
+        if not first_touch:
+            g = _merge_row_sparse(grad_buf, g)
+        # mutate in place: `written` keys on id(grad buffer), which must
+        # stay stable across multiple touches in one backward call
+        grad_buf._rdata = g._rdata
+        grad_buf._indices = g._indices
+        return
+    # dense buffer, sparse cotangent: scatter
+    if first_touch:
+        grad_buf._data = g._scatter_into(
+            jnp.zeros(grad_buf.shape, grad_buf.dtype), accumulate=False)
+    else:
+        grad_buf._data = g._scatter_into(grad_buf._data, accumulate=True)
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):

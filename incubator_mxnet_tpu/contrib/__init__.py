@@ -1,0 +1,10 @@
+"""``mx.contrib`` — control-flow ops and contrib surface (reference
+``python/mxnet/contrib/``)."""
+
+from . import control_flow
+from .control_flow import cond, foreach, while_loop
+
+# reference spelling: mx.nd.contrib.foreach / mx.contrib.nd.foreach
+nd = control_flow
+
+__all__ = ["foreach", "while_loop", "cond", "nd", "control_flow"]

@@ -1,0 +1,211 @@
+"""Control-flow operators: ``foreach`` / ``while_loop`` / ``cond``.
+
+Capability parity with reference ``src/operator/control_flow.cc`` +
+``python/mxnet/ndarray/contrib.py``: loop bodies written against the
+framework API, differentiable end to end, usable for variable-length
+sequence models (the BucketingModule alternative).
+
+TPU-native redesign: the reference runs the body as a captured subgraph
+op with its own gradient subgraph. Here each construct lowers to the
+matching XLA structured-control-flow primitive — ``foreach`` →
+``lax.scan`` (one compiled body, sequential HBM-resident carry),
+``while_loop`` → ``lax.scan`` with an active-mask carry (fixed trip count
+``max_iterations``, which is what makes the op differentiable — reverse-
+mode through a dynamic ``lax.while_loop`` is not defined), ``cond`` →
+``lax.cond``. The whole construct enters the autograd tape as ONE node via
+``invoke``, with its vjp computed by jax through the scan — the analog of
+the reference's subgraph-gradient machinery.
+
+Bodies receive NDArrays whose ``_data`` are tracers; any registered op
+composes. Host-side Python in the body runs once at trace time (XLA
+semantics), matching HybridBlock's hybridize contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import (NDArray, _CaptureScope, _capture_stack,
+                               as_nd, invoke)
+
+
+def _as_list(x) -> Tuple[List, bool]:
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _invoke_with_capture(fused, explicit: List[NDArray], name: str):
+    """Invoke ``fused`` with the body's free NDArrays captured as extra op
+    inputs (the reference subgraph-op implicit-input collection): pass 1
+    abstractly traces to discover them, pass 2 substitutes tracers so
+    jax.vjp differentiates wrt them too."""
+    from .. import autograd as _ag
+
+    scope = _CaptureScope("collect")
+    _capture_stack.append(scope)
+    try:
+        with _ag._RecordingStateScope(False, None):
+            jax.eval_shape(fused, *[x._data for x in explicit])
+    finally:
+        _capture_stack.pop()
+    captured = scope.order
+    n_exp = len(explicit)
+
+    def fused2(*arrays):
+        sub = _CaptureScope("substitute")
+        sub.subst = {id(nd): arr
+                     for nd, arr in zip(captured, arrays[n_exp:])}
+        _capture_stack.append(sub)
+        try:
+            # recording off: jax differentiates THROUGH the traced body;
+            # inner tape nodes would be dead weight (train_mode preserved)
+            with _ag._RecordingStateScope(False, None):
+                return fused(*arrays[:n_exp])
+        finally:
+            _capture_stack.pop()
+
+    results = invoke(fused2, list(explicit) + captured, {}, name=name)
+    return results if isinstance(results, tuple) else (results,)
+
+
+def foreach(body: Callable, data, init_states):
+    """Iterate ``body(data_t, states) -> (outputs, new_states)`` over axis 0
+    of ``data`` (reference ``mx.nd.contrib.foreach``).
+
+    Returns (stacked_outputs, final_states), shapes matching the reference:
+    outputs gain a leading time axis.
+    """
+    datas, data_single = _as_list(data)
+    states, states_single = _as_list(init_states)
+    datas_nd = [as_nd(d) for d in datas]
+    states_nd = [as_nd(s) for s in states]
+    n_data, n_states = len(datas_nd), len(states_nd)
+    out_struct = {}
+
+    def fused(*arrays):
+        xs = list(arrays[:n_data])
+        carry0 = list(arrays[n_data:])
+
+        def step(carry, x_t):
+            outs, new_states = body(
+                _unsingle([NDArray(v) for v in x_t], data_single),
+                _unsingle([NDArray(c) for c in carry], states_single))
+            outs, out_single = _as_list(outs)
+            new_states, _ = _as_list(new_states)
+            out_struct["single"] = out_single
+            return ([s._data if isinstance(s, NDArray) else s
+                     for s in new_states],
+                    tuple(o._data if isinstance(o, NDArray) else o
+                          for o in outs))
+
+        final, stacked = jax.lax.scan(step, carry0, tuple(xs))
+        return tuple(stacked) + tuple(final)
+
+    results = _invoke_with_capture(fused, datas_nd + states_nd, "foreach")
+    n_out = len(results) - n_states
+    outs = list(results[:n_out])
+    final_states = list(results[n_out:])
+    outs_r = outs[0] if out_struct.get("single", True) and len(outs) == 1 \
+        else outs
+    states_r = final_states[0] if states_single else final_states
+    return outs_r, states_r
+
+
+def _unsingle(lst, single):
+    return lst[0] if single else lst
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """``while cond(*loop_vars): outputs, loop_vars = func(*loop_vars)``
+    (reference ``mx.nd.contrib.while_loop``).
+
+    Runs a fixed ``max_iterations`` scan with an active mask — the fixed
+    trip count is what makes reverse-mode differentiation well-defined
+    (reference imposes max_iterations for the same reason). Returns
+    (stacked_outputs, final_loop_vars); output rows beyond the actual
+    iteration count are zeros.
+    """
+    lvars, single = _as_list(loop_vars)
+    lvars_nd = [as_nd(v) for v in lvars]
+    n_vars = len(lvars_nd)
+
+    def fused(*arrays):
+        carry0 = (jnp.asarray(True), list(arrays))
+
+        def step(carry, _):
+            active, vs = carry
+            vs_nd = [NDArray(v) for v in vs]
+            keep_going = cond(*vs_nd)
+            keep_going = (keep_going._data if isinstance(keep_going, NDArray)
+                          else jnp.asarray(keep_going)).reshape(()).astype(
+                              bool)
+            active_now = jnp.logical_and(active, keep_going)
+            outs, new_vs = func(*vs_nd)
+            outs, _ = _as_list(outs)
+            new_vs, _ = _as_list(new_vs)
+            outs = [o._data if isinstance(o, NDArray) else o for o in outs]
+            new_vs = [v._data if isinstance(v, NDArray) else v
+                      for v in new_vs]
+            # only advance state / emit rows while active
+            sel_vs = [jnp.where(active_now, nv, ov)
+                      for nv, ov in zip(new_vs, vs)]
+            sel_outs = tuple(jnp.where(active_now, o, jnp.zeros_like(o))
+                             for o in outs)
+            return (active_now, sel_vs), sel_outs
+
+        (_, final), stacked = jax.lax.scan(
+            step, carry0, None, length=int(max_iterations))
+        return tuple(stacked) + tuple(final)
+
+    results = _invoke_with_capture(fused, lvars_nd, "while_loop")
+    n_out = len(results) - n_vars
+    outs = list(results[:n_out])
+    final_vars = list(results[n_out:])
+    return (outs[0] if len(outs) == 1 else outs,
+            final_vars[0] if single else final_vars)
+
+
+def cond(pred: Callable, then_func: Callable, else_func: Callable,
+         inputs=None):
+    """``then_func() if pred() else else_func()`` (reference
+    ``mx.nd.contrib.cond``).
+
+    With ``inputs`` given, both branches trace under ``lax.cond`` (single
+    compiled op, jit-safe). Without inputs, evaluates eagerly — exactly
+    the reference's imperative behavior (the predicate is a concrete
+    scalar, so only the chosen branch executes).
+    """
+    if inputs is None:
+        p = pred()
+        p_val = bool(p.asscalar() if isinstance(p, NDArray) else p)
+        return then_func() if p_val else else_func()
+
+    ins, _ = _as_list(inputs)
+    ins_nd = [as_nd(i) for i in ins]
+
+    def fused(*arrays):
+        nds = [NDArray(a) for a in arrays]
+        p = pred(*nds)
+        p = (p._data if isinstance(p, NDArray) else jnp.asarray(p)) \
+            .reshape(()).astype(bool)
+
+        def branch(fn):
+            def run(xs):
+                out = fn(*[NDArray(x) for x in xs])
+                outs, _ = _as_list(out)
+                return tuple(o._data if isinstance(o, NDArray) else o
+                             for o in outs)
+            return run
+
+        return jax.lax.cond(p, branch(then_func), branch(else_func),
+                            tuple(arrays))
+
+    out = _invoke_with_capture(fused, ins_nd, "cond")
+    if len(out) == 1:
+        return out[0]
+    return out

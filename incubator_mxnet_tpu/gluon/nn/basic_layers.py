@@ -172,6 +172,40 @@ class HybridLambda(HybridBlock):
         return self._func(*args)
 
 
+class _SparseGradEmbedding(autograd.Function):
+    """Embedding whose backward emits a ``RowSparseNDArray`` weight grad
+    (reference ``sparse_grad=True``: src/operator/tensor/indexing_op.cc
+    EmbeddingOpBackwardEx row_sparse path). The touched row ids are the
+    forward indices; duplicate lookups are segment-summed."""
+
+    def forward(self, x, weight):
+        import jax.numpy as jnp
+
+        from ...ndarray import NDArray
+
+        self.save_for_backward(x, weight)
+        return NDArray(jnp.take(weight._data,
+                                x._data.astype(jnp.int32), axis=0),
+                       ctx=weight.ctx)
+
+    def backward(self, dy):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...ndarray.sparse import RowSparseNDArray
+
+        x, weight = self.saved_tensors
+        idx = np.asarray(x.asnumpy(), np.int64).ravel()
+        uniq, inv = np.unique(idx, return_inverse=True)
+        ct = dy._data.reshape(-1, weight.shape[-1])
+        rows = jax.ops.segment_sum(ct, jnp.asarray(inv),
+                                   num_segments=len(uniq))
+        wgrad = RowSparseNDArray(rows.astype(weight.dtype), uniq,
+                                 weight.shape, weight.ctx)
+        return None, wgrad
+
+
 class Embedding(HybridBlock):
     """Index → vector lookup (reference ``nn.Embedding``); gathers ride the
     TPU's native dynamic-slice path."""
@@ -182,15 +216,25 @@ class Embedding(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer)
+                init=weight_initializer,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x, *args):
         from ... import ndarray as F
 
         params = self._resolve_params(x)
+        if self._sparse_grad:
+            from ... import autograd as _ag
+            from ..parameter import _trace
+
+            # eager-only: under a hybridize/CachedOp trace the indices are
+            # tracers and the host-side row extraction cannot run
+            if _ag.is_recording() and not _trace.stack:
+                return _SparseGradEmbedding()(x, params["weight"])
         return F.Embedding(x, params["weight"], input_dim=self._input_dim,
                            output_dim=self._output_dim)
 

@@ -60,6 +60,12 @@ class Parameter:
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self.allow_deferred_init = allow_deferred_init
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError(f"invalid stype {stype!r}")
+        if grad_stype not in ("default", "row_sparse"):
+            raise ValueError(f"invalid grad_stype {grad_stype!r}")
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._data: Optional[NDArray] = None
         self._deferred = None          # (init, ctx) waiting for a shape
         self._sharding = None          # jax NamedSharding set by parallel layer
@@ -78,7 +84,7 @@ class Parameter:
                 self._data._grad = None
                 self._data._grad_req = "null"
             else:
-                self._data.attach_grad(req)
+                self._data.attach_grad(req, stype=self._grad_stype)
 
     def _shape_known(self) -> bool:
         return (self.shape is not None and len(self.shape) > 0
@@ -110,7 +116,7 @@ class Parameter:
         self._data = nd
         self._deferred = None
         if self._grad_req != "null":
-            self._data.attach_grad(self._grad_req)
+            self._data.attach_grad(self._grad_req, stype=self._grad_stype)
 
     def _finish_deferred_init(self, shape) -> None:
         """Complete deferred init once the first forward reveals the shape."""
@@ -165,7 +171,14 @@ class Parameter:
         if self._data is not None and self._data._grad is not None:
             import jax.numpy as jnp
 
-            self._data._grad._data = jnp.zeros_like(self._data._grad._data)
+            from ..ndarray.sparse import RowSparseNDArray
+
+            g = self._data._grad
+            if isinstance(g, RowSparseNDArray):
+                g._rdata = jnp.zeros((0,) + g.shape[1:], g.dtype)
+                g._indices = jnp.zeros((0,), jnp.int32)
+            else:
+                g._data = jnp.zeros_like(g._data)
 
     def set_data(self, data) -> None:
         new_shape = tuple(getattr(data, "shape", ()) or ())
@@ -178,14 +191,22 @@ class Parameter:
         if tr is not None:
             tr.record_aux_update(self, data)
             return
+        import jax.numpy as jnp
+
+        # copy: set_data COPIES the value into the parameter's own buffer
+        # (reference semantics). Aliasing the source array would let the
+        # optimizer's donated (in-place) update delete a buffer the caller
+        # still holds.
+        src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        copied = jnp.array(src, copy=True)
         if self._data is None:
-            nd = data if isinstance(data, NDArray) else NDArray(data)
-            self.shape = nd.shape
-            self._data = NDArray(nd._data, dtype=self.dtype)
+            self.shape = tuple(src.shape)
+            self._data = NDArray(copied, dtype=self.dtype)
             if self._grad_req != "null":
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
             return
-        self._data._set_data(data)
+        self._data._set_data(copied)
 
     def cast(self, dtype) -> None:
         from ..base import resolve_dtype

@@ -67,7 +67,21 @@ class KVStore:
         return ([key], single) if single else (list(key), False)
 
     @staticmethod
+    def _is_value(v):
+        from .ndarray.sparse import BaseSparseNDArray
+
+        return isinstance(v, (NDArray, BaseSparseNDArray))
+
+    @staticmethod
     def _val_list(value, n):
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if isinstance(value, BaseSparseNDArray):
+            if n != 1:
+                raise ValueError(
+                    f"got a single sparse NDArray for {n} keys; pass one "
+                    "value (or per-device value list) per key")
+            return [[value]]
         if isinstance(value, NDArray):
             if n != 1:
                 raise ValueError(
@@ -75,7 +89,7 @@ class KVStore:
                     "(or per-device value list) per key")
             return [[value]]
         if isinstance(value, (list, tuple)):
-            if n == 1 and all(isinstance(v, NDArray) for v in value):
+            if n == 1 and all(KVStore._is_value(v) for v in value):
                 return [list(value)]
             if len(value) != n:
                 raise ValueError(
@@ -85,6 +99,8 @@ class KVStore:
         raise TypeError(f"bad value type {type(value)}")
 
     def init(self, key, value) -> None:
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, _ = self._key_list(key)
         vals = self._val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
@@ -93,17 +109,58 @@ class KVStore:
             v = vlist[0] if isinstance(vlist, (list, tuple)) else vlist
             if isinstance(v, (list, tuple)):
                 v = v[0]
+            if isinstance(v, BaseSparseNDArray):
+                # stored densely: XLA has no sparse layout, so the store's
+                # canonical form is dense HBM; row_sparse_pull serves the
+                # sparse view (divergence from the reference's rsp-typed
+                # server storage, same capability surface)
+                v = v.todense()
             self._store[k] = NDArray(v._data, ctx=v.ctx)
 
     def push(self, key, value, priority: int = 0) -> None:
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, _ = self._key_list(key)
         vals = self._val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             agg = self._reduce(vlist)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
+            elif isinstance(agg, RowSparseNDArray):
+                # no updater: pushed rsp values overwrite the touched rows
+                self._store[k]._set_data(
+                    agg._scatter_into(self._store[k]._data,
+                                      accumulate=False))
             else:
                 self._store[k]._set_data(agg._data)
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0,
+                        row_ids=None) -> None:
+        """Pull only the requested rows as RowSparseNDArrays (reference
+        ``KVStore.row_sparse_pull`` — the sparse-embedding serving path)."""
+        import numpy as _np
+
+        from .ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise ValueError("row_sparse_pull requires row_ids")
+        keys, _ = self._key_list(key)
+        outs = self._val_list(out, len(keys))
+        ids_list = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, ids_list):
+            src = self._store[k]
+            rows = _np.unique(_np.asarray(
+                rid.asnumpy() if hasattr(rid, "asnumpy") else rid,
+                _np.int64).ravel())
+            data = src._data[jnp.asarray(rows)]
+            for o in (olist if isinstance(olist, (list, tuple)) else [olist]):
+                if isinstance(o, RowSparseNDArray):
+                    o._rdata = jnp.asarray(data, o.dtype)
+                    o._indices = jnp.asarray(rows, jnp.int32)
+                else:
+                    raise TypeError(
+                        "row_sparse_pull outputs must be RowSparseNDArray")
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
@@ -118,6 +175,8 @@ class KVStore:
         """Fused allreduce (reference ``MXKVStorePushPullEx``): sum the
         pushed values and write the result to ``out`` (grads in, summed
         grads out — no optimizer involved)."""
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, _ = self._key_list(key)
         vals = self._val_list(value, len(keys))
         if out is None:
@@ -127,17 +186,36 @@ class KVStore:
         for k, vlist, olist in zip(keys, vals, outs):
             agg = self._reduce(vlist)
             for o in (olist if isinstance(olist, (list, tuple)) else [olist]):
-                o._set_data(jnp.asarray(agg._data, o.dtype))
+                if isinstance(o, RowSparseNDArray):
+                    if isinstance(agg, RowSparseNDArray):
+                        o._rdata = jnp.asarray(agg._rdata, o.dtype)
+                        o._indices = agg._indices
+                    else:
+                        cast = agg.tostype("row_sparse")
+                        o._rdata = jnp.asarray(cast._rdata, o.dtype)
+                        o._indices = cast._indices
+                elif isinstance(agg, RowSparseNDArray):
+                    o._set_data(agg._scatter_into(
+                        jnp.zeros(o.shape, o.dtype), accumulate=False))
+                else:
+                    o._set_data(jnp.asarray(agg._data, o.dtype))
 
     def broadcast(self, key, value, out, priority: int = 0) -> None:
         self.init(key, value)
         self.pull(key, out, priority)
 
     def _reduce(self, vlist: List[NDArray]) -> NDArray:
+        from .ndarray import sparse as _sparse
+
         if not isinstance(vlist, (list, tuple)):
             return vlist
         if len(vlist) == 1:
             return vlist[0]
+        if any(isinstance(v, _sparse.RowSparseNDArray) for v in vlist):
+            acc = vlist[0]
+            for v in vlist[1:]:
+                acc = _sparse.add(acc, v)
+            return acc
         acc = vlist[0]._data
         for v in vlist[1:]:
             acc = acc + v._data

@@ -15,6 +15,9 @@ from types import ModuleType as _ModuleType
 from .ndarray import (NDArray, array, as_nd, arange, empty, eye, full, invoke,
                       invoke_op, load, ones, ones_like, save, waitall, zeros,
                       zeros_like)
+from . import sparse
+from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
+                     cast_storage)
 from ..ops import registry as _registry
 from ..ops import tensor as _t  # ensure registration  # noqa: F401
 from ..ops import nn as _nn  # noqa: F401

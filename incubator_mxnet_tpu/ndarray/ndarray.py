@@ -68,6 +68,53 @@ def _narrow_x32(dt):
 
 
 # ---------------------------------------------------------------------------
+# Free-variable capture for fused subgraph ops (control flow / SymbolBlock).
+# The reference's subgraph ops collect NDArrays referenced by the body as
+# implicit op inputs so gradients reach them; here a two-pass scheme does
+# the same: a 'collect' pass records concrete grad-relevant NDArrays seen
+# by inner invokes, then a 'substitute' pass swaps their data for tracers
+# of the enclosing differentiated function.
+# ---------------------------------------------------------------------------
+class _CaptureScope:
+    __slots__ = ("mode", "order", "by_id", "subst")
+
+    def __init__(self, mode: str):
+        self.mode = mode          # 'collect' | 'substitute'
+        self.order: list = []     # NDArrays, in first-seen order
+        self.by_id: dict = {}
+        self.subst: dict = {}     # id(NDArray) -> tracer
+
+    def add(self, x: "NDArray") -> None:
+        if id(x) not in self.by_id:
+            self.by_id[id(x)] = x
+            self.order.append(x)
+
+
+_capture_stack: list = []
+
+
+def _maybe_capture(in_nd):
+    if not _capture_stack:
+        return in_nd
+    top = _capture_stack[-1]
+    if top.mode == "collect":
+        for x in in_nd:
+            if (not isinstance(x._data, jax.core.Tracer)
+                    and (x._grad is not None or x._ag_node is not None)):
+                top.add(x)
+        return in_nd
+    out = []
+    for x in in_nd:
+        tr = top.subst.get(id(x))
+        if tr is not None:
+            y = NDArray(tr, ctx=x._ctx)
+            out.append(y)
+        else:
+            out.append(x)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Imperative dispatch (the Imperative::Invoke analog, SURVEY.md §3.1)
 # ---------------------------------------------------------------------------
 def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
@@ -85,7 +132,7 @@ def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
         from .. import random as _random
 
         kwargs["rng"] = _random.next_key()
-    in_nd = [as_nd(x) for x in inputs]
+    in_nd = _maybe_capture([as_nd(x) for x in inputs])
     in_data = [x._data for x in in_nd]
     if _amp_policy is not None and name:
         # fold the AMP casts INTO the differentiated function so vjp sees
@@ -254,8 +301,16 @@ class NDArray:
 
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype=None) -> None:
-        """Allocate a gradient buffer (reference ``NDArray.attach_grad``)."""
-        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        """Allocate a gradient buffer (reference ``NDArray.attach_grad``);
+        ``stype='row_sparse'`` makes backward store a row-sparse grad."""
+        if stype == "row_sparse":
+            from . import sparse as _sparse
+
+            self._grad = _sparse.zeros("row_sparse", self.shape,
+                                       ctx=self._ctx, dtype=self.dtype)
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
+                                 ctx=self._ctx)
         self._grad_req = grad_req
         self._ag_node = None
         self._ag_out_idx = 0
@@ -525,10 +580,13 @@ class NDArray:
                       * (on_value - off_value) + off_value,
                       [self], name="one_hot", differentiable=False)
 
-    def tostype(self, stype: str) -> "NDArray":
-        if stype != "default":
-            raise NotImplementedError("sparse storage arrives in a later layer")
-        return self
+    def tostype(self, stype: str):
+        """Convert to a storage type (reference ``NDArray.tostype``)."""
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+
+        return _sparse.cast_storage(self, stype)
 
     # numpy-protocol interop
     def __array__(self, dtype=None):

@@ -1,0 +1,358 @@
+"""Sparse storage types — ``row_sparse`` and ``csr``.
+
+Capability parity with reference ``python/mxnet/ndarray/sparse.py`` +
+``src/ndarray/ndarray.cc`` storage types: ``RowSparseNDArray`` (subset of
+rows materialized — embedding/optimizer gradients), ``CSRNDArray``
+(compressed rows — sparse feature matrices), ``cast_storage``/``tostype``,
+``retain``, sparse-aware ``dot``, and sparse gradients for Embedding with
+lazy optimizer updates.
+
+TPU-native redesign: the reference's sparse kernels are CPU/GPU loops; XLA
+has no native sparse layout, so sparse arrays here are index+value pairs of
+dense jax arrays — gather/scatter (``take``/``segment_sum``/``at[].add``)
+compile to the TPU's native dynamic-slice/scatter path, which is exactly
+how XLA would lower a sparse op anyway. nnz is data-dependent, so
+storage-casting ops run eagerly on host metadata (outside jit); the
+*kernels* that consume sparse operands (csr·dense, lazy row updates) are
+jitted with static nnz per shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..device import Context, current_context
+from .ndarray import NDArray, as_nd
+
+
+class BaseSparseNDArray:
+    """Common surface of the sparse storage types (NOT an NDArray subclass:
+    dense-only ops must reject sparse operands loudly, as the reference
+    does via FInferStorageType fallback errors)."""
+
+    _shape: Tuple[int, ...]
+    _ctx: Context
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def ctx(self) -> Context:
+        return self._ctx
+
+    context = ctx
+
+    def wait_to_read(self):
+        jax.block_until_ready(self.data._data)
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self.shape} "
+                f"@{self._ctx}>")
+
+    def asnumpy(self) -> np.ndarray:
+        return self.todense().asnumpy()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows ``indices`` hold ``data``; all other rows are zero (reference
+    ``RowSparseNDArray``). Canonical form keeps indices sorted unique."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._rdata = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        if self._rdata.shape[0] != self._indices.shape[0]:
+            raise ValueError(
+                f"data rows {self._rdata.shape[0]} != indices "
+                f"{self._indices.shape[0]}")
+
+    # -- reference accessors -------------------------------------------------
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._rdata, ctx=self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    @property
+    def dtype(self):
+        return self._rdata.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[0])
+
+    # -- conversion ----------------------------------------------------------
+    def todense(self) -> NDArray:
+        return NDArray(dense_from_row_sparse(
+            self._rdata, self._indices, self._shape), ctx=self._ctx)
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(f"cast row_sparse -> {stype!r} not supported")
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the listed rows (reference ``sparse.retain``)."""
+        keep = np.asarray(as_nd(row_ids).asnumpy(), np.int64).ravel()
+        have = np.asarray(self._indices)
+        mask = np.isin(have, keep)
+        sel = np.nonzero(mask)[0]
+        return RowSparseNDArray(self._rdata[jnp.asarray(sel)],
+                                have[mask], self._shape, self._ctx)
+
+    def copy(self) -> "RowSparseNDArray":
+        # a real copy: grad buffers are mutated in place (_rdata/_indices
+        # rebinding), so aliasing would let zero_grad/step wipe snapshots
+        return RowSparseNDArray(self._rdata, self._indices, self._shape,
+                                self._ctx)
+
+    # -- arithmetic ----------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return _merge_row_sparse(self, other)
+        return self.todense() + other
+
+    __radd__ = __add__
+
+    def _scatter_into(self, dense: jax.Array, accumulate: bool) -> jax.Array:
+        """dense (+)= self — the lazy-update/grad-write primitive."""
+        if accumulate:
+            return dense.at[self._indices].add(
+                self._rdata.astype(dense.dtype))
+        return dense.at[self._indices].set(self._rdata.astype(dense.dtype))
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row 2-D matrix (reference ``CSRNDArray``)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._cdata = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        if len(self._shape) != 2:
+            raise ValueError("csr storage is 2-D only")
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._cdata, ctx=self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def dtype(self):
+        return self._cdata.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._cdata.shape[0])
+
+    def _row_ids(self) -> jax.Array:
+        """COO row index per nonzero (host-computed; indptr is concrete)."""
+        counts = np.diff(np.asarray(self._indptr))
+        return jnp.asarray(np.repeat(np.arange(self._shape[0]), counts),
+                           jnp.int32)
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self._cdata.dtype)
+        dense = dense.at[self._row_ids(), self._indices].set(self._cdata)
+        return NDArray(dense, ctx=self._ctx)
+
+    def copy(self) -> "CSRNDArray":
+        return CSRNDArray(self._cdata, self._indices, self._indptr,
+                          self._shape, self._ctx)
+
+    def tostype(self, stype: str):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(f"cast csr -> {stype!r} not supported")
+
+    def dot(self, dense: Union[NDArray, np.ndarray],
+            transpose_a: bool = False) -> NDArray:
+        return dot(self, dense, transpose_a=transpose_a)
+
+    def __getitem__(self, key):
+        # row slicing (reference CSR slice support)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise ValueError("csr slicing requires step 1")
+            iptr = np.asarray(self._indptr)
+            lo, hi = int(iptr[start]), int(iptr[stop])
+            return CSRNDArray(self._cdata[lo:hi], self._indices[lo:hi],
+                              iptr[start:stop + 1] - lo,
+                              (stop - start, self._shape[1]), self._ctx)
+        raise TypeError("csr supports row-slice indexing only")
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+def dense_from_row_sparse(rdata, indices, shape):
+    dense = jnp.zeros(shape, rdata.dtype)
+    return dense.at[indices].set(rdata)
+
+
+def _merge_row_sparse(a: RowSparseNDArray,
+                      b: RowSparseNDArray) -> RowSparseNDArray:
+    """Sum two row-sparse arrays (canonical sorted-unique result)."""
+    ia, ib = np.asarray(a._indices), np.asarray(b._indices)
+    uniq, inv = np.unique(np.concatenate([ia, ib]), return_inverse=True)
+    rows = jax.ops.segment_sum(
+        jnp.concatenate([a._rdata, b._rdata.astype(a._rdata.dtype)], 0),
+        jnp.asarray(inv), num_segments=len(uniq))
+    return RowSparseNDArray(rows, uniq, a._shape, a._ctx)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference mx.nd.sparse.* factory functions)
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """``row_sparse_array((data, indices), shape)`` or from a dense array."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = as_nd(data)._data if not isinstance(data, np.ndarray) \
+            else jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        indices = np.asarray(as_nd(indices).asnumpy(), np.int64).ravel()
+        order = np.argsort(indices)
+        if shape is None:
+            raise ValueError("shape required for (data, indices) input")
+        return RowSparseNDArray(data[jnp.asarray(order)], indices[order],
+                                shape, ctx)
+    return cast_storage(as_nd(arg1, dtype=dtype), "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """``csr_matrix((data, indices, indptr), shape)`` or from dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(np.asarray(as_nd(data).asnumpy()))
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape required for (data, indices, indptr)")
+        return CSRNDArray(data, np.asarray(as_nd(indices).asnumpy()),
+                          np.asarray(as_nd(indptr).asnumpy()), shape, ctx)
+    return cast_storage(as_nd(arg1, dtype=dtype), "csr")
+
+
+def zeros(stype: str, shape, ctx=None, dtype="float32"):
+    import numpy as _np
+
+    dt = _np.dtype(dtype) if not isinstance(dtype, str) else dtype
+    if stype == "row_sparse":
+        row_shape = tuple(shape)[1:]
+        return RowSparseNDArray(jnp.zeros((0,) + row_shape, dt),
+                                jnp.zeros((0,), jnp.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((tuple(shape)[0] + 1,), jnp.int32),
+                          shape, ctx)
+    from . import ndarray as _nd
+
+    return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype: str):
+    """Dense ⇄ sparse conversion (reference ``cast_storage`` op). nnz is
+    data-dependent → runs eagerly (host metadata), as in the reference's
+    CPU fallback for this op."""
+    if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+        return arr.tostype(stype)
+    arr = as_nd(arr)
+    if stype == "default":
+        return arr
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(a[nz_rows]), nz_rows,
+                                arr.shape, arr.ctx)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise ValueError("csr storage is 2-D only")
+        rows, cols = np.nonzero(a)
+        indptr = np.zeros(a.shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        return CSRNDArray(jnp.asarray(a[rows, cols]), cols, indptr,
+                          arr.shape, arr.ctx)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+def retain(arr: RowSparseNDArray, row_ids):
+    return arr.retain(row_ids)
+
+
+# ---------------------------------------------------------------------------
+# sparse dot
+# ---------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a: bool = False) -> NDArray:
+    """``sparse.dot``: csr·dense → dense (and csrᵀ·dense). The workhorse
+    of reference LibSVM linear models (src/operator/tensor/dot.cc sparse
+    paths); lowered to gather + segment-sum, XLA's native scatter path."""
+    if isinstance(lhs, CSRNDArray):
+        rhs_nd = as_nd(rhs)
+        rows = lhs._row_ids()
+        if transpose_a:
+            # (csrᵀ · dense)[j] = Σ_nz data·dense[row]  grouped by column j
+            out = jax.ops.segment_sum(
+                lhs._cdata[:, None] * rhs_nd._data[rows],
+                lhs._indices, num_segments=lhs._shape[1])
+            return NDArray(out, ctx=lhs._ctx)
+        gathered = lhs._cdata[:, None] * rhs_nd._data[lhs._indices]
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs._shape[0])
+        return NDArray(out, ctx=lhs._ctx)
+    if isinstance(lhs, RowSparseNDArray):
+        return NDArray(jnp.matmul(lhs.todense()._data, as_nd(rhs)._data),
+                       ctx=lhs._ctx)
+    from . import ndarray as _impl
+
+    return _impl.NDArray(jnp.matmul(as_nd(lhs)._data, as_nd(rhs)._data))
+
+
+def add(lhs, rhs):
+    """Sparse-aware add: rsp+rsp → rsp; anything else densifies."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        return _merge_row_sparse(lhs, rhs)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else as_nd(lhs)
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else as_nd(rhs)
+    return l + r
+
+
+def elemwise_add(lhs, rhs):
+    return add(lhs, rhs)

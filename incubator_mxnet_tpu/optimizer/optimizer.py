@@ -135,8 +135,20 @@ class Optimizer:
     def update(self, index, weight: NDArray, grad: NDArray, state):
         raise NotImplementedError
 
+    # optimizers with a true row-sparse (lazy) update path override this
+    _supports_sparse_grad = False
+
     def update_multi_precision(self, index, weight: NDArray, grad: NDArray,
                                state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) and (
+                not self._supports_sparse_grad
+                or (self.multi_precision
+                    and weight.dtype in (jnp.float16, jnp.bfloat16))):
+            # reference behavior for dense-only rules (and the fp32-master
+            # path, which owns a dense master weight): densify the grad
+            grad = grad.todense()
         if self.multi_precision and isinstance(state, tuple) \
                 and len(state) == 2 and isinstance(state[0], jax.Array) \
                 and state[0].dtype == jnp.float32 \
@@ -151,6 +163,14 @@ class Optimizer:
         return self.update(index, weight, grad, state)
 
     # -- jit plumbing --------------------------------------------------------
+    def _hyper_key(self) -> tuple:
+        """Every plain scalar attribute of the rule, as cache-key material
+        (closure-captured hyperparameters define the compiled executable)."""
+        return tuple(sorted(
+            (k, v) for k, v in self.__dict__.items()
+            if not k.startswith("_")
+            and isinstance(v, (int, float, bool, str, type(None)))))
+
     def _run(self, key, fn, weight: NDArray, grad, state_arrays, scalars):
         """Jit-cached execution of an update rule.
 
@@ -158,13 +178,14 @@ class Optimizer:
         (lr, wd, t, ...) are passed as traced args so one executable serves
         every step and every layer of the same shape.
         """
-        # rescale_grad/clip_gradient are captured in the rule closures, so
+        # ALL scalar hyperparameters are captured in the rule closures, so
         # they are part of the executable identity: keying on them makes a
-        # changed rescale (e.g. Trainer.step with a partial final batch)
-        # recompile instead of silently reusing the stale constant.
+        # changed value (rescale on a partial final batch, a momentum
+        # warm-up schedule mutating opt.momentum, …) recompile instead of
+        # silently reusing the stale constant.
         cache_key = (type(self).__name__, key, weight.shape,
                      str(weight.dtype), tuple(s.shape for s in state_arrays),
-                     float(self.rescale_grad), self.clip_gradient)
+                     self._hyper_key())
         jfn = self._jit_cache.get(cache_key)
         if jfn is None:
             # donate weight + states (in-place update in HBM); grad NOT
@@ -190,6 +211,8 @@ class SGD(Optimizer):
     """SGD with momentum + optional lazy/multi-precision (reference
     ``sgd_update``/``sgd_mom_update``/``mp_sgd_update`` kernels)."""
 
+    _supports_sparse_grad = True
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -200,7 +223,48 @@ class SGD(Optimizer):
             return None
         return jnp.zeros(weight.shape, weight.dtype)
 
+    def _update_row_sparse(self, index, weight, grad, state):
+        """Lazy SGD over a row-sparse grad (reference ``sgd_update`` /
+        ``sgd_mom_update`` row_sparse paths with ``lazy_update=True``):
+        only the touched rows of weight (and momentum) move; untouched
+        momentum does NOT decay — the documented lazy semantics."""
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
+            self.momentum
+        has_mom = state is not None
+        key = ("sgd_rsp", weight.shape, str(weight.dtype),
+               int(grad._rdata.shape[0]), has_mom, self._hyper_key())
+        jfn = self._jit_cache.get(key)
+        if jfn is None:
+            def fn(w, rows, idx, m, lr, wd):
+                wr = w[idx]
+                g = rows.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + wd.astype(w.dtype) * wr
+                if has_mom:
+                    mr = mom * m[idx] - lr.astype(w.dtype) * g
+                    return (w.at[idx].set(wr + mr),
+                            m.at[idx].set(mr))
+                return w.at[idx].set(wr - lr.astype(w.dtype) * g), m
+
+            jfn = jax.jit(fn, donate_argnums=(0, 3))
+            self._jit_cache[key] = jfn
+        m_in = state if has_mom else jnp.zeros((0,), weight.dtype)
+        new_w, new_m = jfn(weight._data, grad._rdata, grad._indices, m_in,
+                           jnp.asarray(lr, jnp.float32),
+                           jnp.asarray(wd, jnp.float32))
+        weight._set_data(new_w)
+        return new_m if has_mom else None
+
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._update_row_sparse(index, weight, grad, state)
+            grad = grad.todense()
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         rescale, clip, mom = self.rescale_grad, self.clip_gradient, \

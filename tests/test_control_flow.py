@@ -1,0 +1,148 @@
+"""Control-flow ops: foreach/while_loop/cond with autograd through the
+construct (SURVEY.md §2.1 operator-library row; reference
+src/operator/control_flow.cc, python/mxnet/ndarray/contrib.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import contrib, gluon
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+    outs, final = contrib.foreach(lambda x, s: (s + x, s + x), data, init)
+    expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1])
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = mx.nd.array(np.ones((3, 2), np.float32))
+    s1, s2 = mx.nd.zeros((2,)), mx.nd.ones((2,))
+
+    def body(x, states):
+        a, b = states
+        return [a + x, b * 2], [a + x, b * 2]
+
+    outs, finals = contrib.foreach(body, data, [s1, s2])
+    assert len(outs) == 2 and len(finals) == 2
+    np.testing.assert_allclose(finals[0].asnumpy(), [3.0, 3.0])
+    np.testing.assert_allclose(finals[1].asnumpy(), [8.0, 8.0])
+    assert outs[0].shape == (3, 2)
+
+
+def test_foreach_gradient_through_closure():
+    """Free NDArrays in the body are captured as implicit inputs (the
+    reference subgraph-op behavior) and receive gradients."""
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+    w = mx.nd.ones((3,))
+    w.attach_grad()
+    with mx.autograd.record():
+        _, final = contrib.foreach(
+            lambda x, s: (s + x * w, s + x * w), data, init)
+        loss = final.sum()
+    loss.backward()
+    np.testing.assert_allclose(
+        w.grad.asnumpy(), np.arange(12).reshape(4, 3).sum(0))
+
+
+def test_foreach_gradient_wrt_data_and_state():
+    data = mx.nd.uniform(shape=(5, 4))
+    init = mx.nd.uniform(shape=(4,))
+    data.attach_grad()
+    init.attach_grad()
+    with mx.autograd.record():
+        _, final = contrib.foreach(
+            lambda x, s: (s * x, s * x), data, init)
+        loss = final.sum()
+    loss.backward()
+    # d final / d init = prod of all data rows
+    np.testing.assert_allclose(init.grad.asnumpy(),
+                               np.prod(data.asnumpy(), 0), rtol=1e-4)
+    assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_foreach_rnn_cell_trains():
+    """RNN-through-foreach: the lax.scan analog of the reference's
+    fused-RNN-over-subgraph path, trained end to end."""
+    np.random.seed(0)
+    dim, hidden, T, B = 4, 8, 6, 16
+    cell = gluon.rnn.RNNCell(hidden, input_size=dim)
+    cell.initialize(init="xavier")
+    dense = gluon.nn.Dense(2, in_units=hidden)
+    dense.initialize(init="xavier")
+    params = list(cell.collect_params()._params.values()) + \
+        list(dense.collect_params()._params.values())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+    x_np = np.random.randn(T, B, dim).astype(np.float32)
+    y_np = (x_np.mean(0)[:, 0] > 0).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = None
+    for _ in range(20):
+        x = mx.nd.array(x_np)
+        with mx.autograd.record():
+            def body(x_t, h):
+                out, new_states = cell(x_t, [h])
+                return out, new_states[0]
+
+            _, h_final = contrib.foreach(body, x, mx.nd.zeros((B, hidden)))
+            l = loss_fn(dense(h_final), mx.nd.array(y_np)).mean()
+        l.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(l.asscalar())
+    assert float(l.asscalar()) < first
+
+
+def test_while_loop_basic():
+    outs, (i_f, s_f) = contrib.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (s + i, (i + 1, s + i)),
+        (mx.nd.array([0.0]), mx.nd.array([0.0])), max_iterations=8)
+    np.testing.assert_allclose(s_f.asnumpy(), [10.0])
+    np.testing.assert_allclose(i_f.asnumpy(), [5.0])
+    assert outs.shape == (8, 1)  # padded to max_iterations
+    np.testing.assert_allclose(outs.asnumpy()[5:], 0.0)  # padding rows
+
+
+def test_while_loop_gradient():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        _, (i_f, acc) = contrib.while_loop(
+            lambda i, a: i < 3,
+            lambda i, a: (a, (i + 1, a * x)),
+            (mx.nd.array([0.0]), mx.nd.array([1.0])), max_iterations=5)
+        loss = acc.sum()  # x^3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3 * 2.0 ** 2], rtol=1e-5)
+
+
+def test_cond_lax_and_eager():
+    a, b = mx.nd.array([2.0]), mx.nd.array([5.0])
+    out = contrib.cond(lambda a, b: (a < b).sum() > 0,
+                       lambda a, b: a + b, lambda a, b: a - b, [a, b])
+    np.testing.assert_allclose(out.asnumpy(), [7.0])
+    out = contrib.cond(lambda a, b: (a > b).sum() > 0,
+                       lambda a, b: a + b, lambda a, b: a - b, [a, b])
+    np.testing.assert_allclose(out.asnumpy(), [-3.0])
+    # eager form: only the selected branch runs
+    ran = []
+    out = contrib.cond(lambda: mx.nd.array([1.0]).sum() > 0,
+                       lambda: (ran.append("then"), a * b)[1],
+                       lambda: (ran.append("else"), a)[1])
+    np.testing.assert_allclose(out.asnumpy(), [10.0])
+    assert ran == ["then"]
+
+
+def test_cond_gradient_selected_branch():
+    a = mx.nd.array([3.0])
+    a.attach_grad()
+    with mx.autograd.record():
+        out = contrib.cond(lambda x: (x > 0).sum() > 0,
+                           lambda x: x * x, lambda x: -x, [a])
+        out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [6.0])

@@ -1,0 +1,309 @@
+"""Sparse storage: row_sparse/csr NDArrays, cast_storage, sparse dot,
+sparse embedding grads + lazy SGD, kvstore sparse paths (SURVEY.md §2.1
+NDArray row; reference python/mxnet/ndarray/sparse.py,
+src/operator/tensor/dot.cc sparse paths, indexing_op.cc sparse backward)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense_sparse_rows(shape=(6, 4), nz_rows=(1, 4), seed=0):
+    rng = np.random.RandomState(seed)
+    a = np.zeros(shape, np.float32)
+    for r in nz_rows:
+        a[r] = rng.randn(*shape[1:])
+    return a
+
+
+# ---------------------------------------------------------------------------
+# storage casts
+# ---------------------------------------------------------------------------
+def test_cast_storage_row_sparse_roundtrip():
+    a = _rand_dense_sparse_rows()
+    rsp = mx.nd.array(a).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(rsp.asnumpy(), a)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), a)
+
+
+def test_cast_storage_csr_roundtrip():
+    rng = np.random.RandomState(1)
+    a = rng.randn(5, 7).astype(np.float32)
+    a[a < 0.3] = 0  # sparsify
+    csr = mx.nd.array(a).tostype("csr")
+    assert csr.stype == "csr"
+    assert csr.nnz == int((a != 0).sum())
+    np.testing.assert_allclose(csr.asnumpy(), a)
+
+
+def test_row_sparse_array_constructor_sorts():
+    data = np.array([[3.0, 3], [1, 1]], np.float32)
+    rsp = sparse.row_sparse_array((data, [3, 1]), shape=(5, 2))
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    dense = rsp.asnumpy()
+    np.testing.assert_allclose(dense[1], [1, 1])
+    np.testing.assert_allclose(dense[3], [3, 3])
+
+
+def test_csr_matrix_constructor_and_slice():
+    a = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], np.float32)
+    csr = sparse.csr_matrix(a)
+    np.testing.assert_allclose(csr.asnumpy(), a)
+    sl = csr[1:3]
+    np.testing.assert_allclose(sl.asnumpy(), a[1:3])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.nnz == 0
+    np.testing.assert_allclose(z.asnumpy(), np.zeros((4, 3)))
+    zc = sparse.zeros("csr", (4, 3))
+    np.testing.assert_allclose(zc.asnumpy(), np.zeros((4, 3)))
+
+
+def test_retain():
+    a = _rand_dense_sparse_rows(nz_rows=(0, 2, 5))
+    rsp = sparse.row_sparse_array(a)
+    kept = rsp.retain(mx.nd.array([0, 5]))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [0, 5])
+    expect = a.copy()
+    expect[2] = 0
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_rsp_add():
+    a = _rand_dense_sparse_rows(nz_rows=(1, 3), seed=2)
+    b = _rand_dense_sparse_rows(nz_rows=(3, 5), seed=3)
+    out = sparse.add(sparse.row_sparse_array(a), sparse.row_sparse_array(b))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 3, 5])
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+    # rsp + dense densifies
+    d = (sparse.row_sparse_array(a) + mx.nd.array(b))
+    np.testing.assert_allclose(d.asnumpy(), a + b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse dot
+# ---------------------------------------------------------------------------
+def test_csr_dot_dense_matches_oracle():
+    rng = np.random.RandomState(0)
+    a = rng.randn(6, 8).astype(np.float32)
+    a[np.abs(a) < 0.8] = 0
+    b = rng.randn(8, 5).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-6)
+
+
+def test_csr_dot_dense_transpose():
+    rng = np.random.RandomState(1)
+    a = rng.randn(6, 8).astype(np.float32)
+    a[np.abs(a) < 0.8] = 0
+    b = rng.randn(6, 3).astype(np.float32)
+    out = sparse.dot(sparse.csr_matrix(a), mx.nd.array(b), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding gradients + lazy optimizer
+# ---------------------------------------------------------------------------
+def test_sparse_grad_embedding_backward_is_row_sparse():
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(init="xavier")
+    x = mx.nd.array(np.array([[1, 3], [3, 7]]), dtype="int32")
+    with mx.autograd.record():
+        out = emb(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, sparse.RowSparseNDArray)
+    np.testing.assert_array_equal(g.indices.asnumpy(), [1, 3, 7])
+    # oracle: dense embedding gradient
+    emb_d = gluon.nn.Embedding(10, 4)
+    emb_d.initialize()
+    emb_d.weight.set_data(emb.weight.data())
+    with mx.autograd.record():
+        loss_d = (emb_d(x) * emb_d(x)).sum()
+    loss_d.backward()
+    np.testing.assert_allclose(g.asnumpy(), emb_d.weight.grad().asnumpy(),
+                               rtol=1e-5)
+
+
+def test_sparse_embedding_training_matches_dense():
+    """Lazy SGD (momentum=0) on rsp grads must match dense SGD exactly
+    when wd=0 — the reference lazy_update equivalence case."""
+    np.random.seed(0)
+
+    def build(sparse_grad):
+        e = gluon.nn.Embedding(20, 8, sparse_grad=sparse_grad)
+        e.initialize(init="xavier")
+        return e
+
+    e_sparse, e_dense = build(True), build(False)
+    e_dense.weight.set_data(e_sparse.weight.data())
+    t_s = gluon.Trainer(e_sparse.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "wd": 0.0})
+    t_d = gluon.Trainer(e_dense.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "wd": 0.0})
+    for step in range(5):
+        idx = np.random.randint(0, 20, (4, 3))
+        x = mx.nd.array(idx, dtype="int32")
+        with mx.autograd.record():
+            l_s = (e_sparse(x) ** 2).sum()
+        l_s.backward()
+        t_s.step(1)
+        with mx.autograd.record():
+            l_d = (e_dense(x) ** 2).sum()
+        l_d.backward()
+        t_d.step(1)
+    np.testing.assert_allclose(e_sparse.weight.data().asnumpy(),
+                               e_dense.weight.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_sgd_momentum_only_touches_rows():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.ones((5, 2))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [2]), shape=(5, 2))
+    updater(0, g, w)
+    w1 = w.asnumpy()
+    # only row 2 moved
+    np.testing.assert_allclose(w1[[0, 1, 3, 4]], 1.0)
+    assert not np.allclose(w1[2], 1.0)
+    # second step: momentum accumulates on the touched row only
+    updater(0, g, w)
+    w2 = w.asnumpy()
+    np.testing.assert_allclose(w2[[0, 1, 3, 4]], 1.0)
+    assert w2[2][0] < w1[2][0]
+
+
+def test_dense_only_optimizer_densifies_sparse_grad():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("adam", learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.ones((4, 2))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [1]), shape=(4, 2))
+    updater(0, g, w)  # must not raise
+    assert np.isfinite(w.asnumpy()).all()
+
+
+def test_parameter_grad_stype_row_sparse():
+    p = gluon.Parameter("w", shape=(6, 3), grad_stype="row_sparse")
+    p.initialize()
+    assert isinstance(p.grad(), sparse.RowSparseNDArray)
+    p.zero_grad()
+    assert p.grad().nnz == 0
+
+
+# ---------------------------------------------------------------------------
+# kvstore sparse
+# ---------------------------------------------------------------------------
+def test_kvstore_sparse_push_and_row_sparse_pull():
+    kv = mx.kvstore.create("local")
+    init = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("w", mx.nd.array(init))
+    # push rsp grads from two "devices": rows merge-summed
+    g1 = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [1]), shape=(6, 2))
+    g2 = sparse.row_sparse_array(
+        (2 * np.ones((1, 2), np.float32), [4]), shape=(6, 2))
+    kv.set_updater(lambda k, g, s: s._set_data(
+        g._scatter_into(s._data, accumulate=True)
+        if isinstance(g, sparse.RowSparseNDArray) else s._data + g._data))
+    kv.push("w", [g1, g2])
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([1, 4]))
+    np.testing.assert_allclose(out.asnumpy()[1], init[1] + 1)
+    np.testing.assert_allclose(out.asnumpy()[4], init[4] + 2)
+    assert out.nnz == 2
+
+
+def test_kvstore_pushpull_with_sparse_grads():
+    """Trainer-style pushpull with rsp values (review regression)."""
+    kv = mx.kvstore.create("device")
+    kv.init("w", mx.nd.zeros((5, 2)))
+    g = sparse.row_sparse_array(
+        (np.ones((2, 2), np.float32), [0, 3]), shape=(5, 2))
+    out = sparse.zeros("row_sparse", (5, 2))
+    kv.pushpull("w", g, out=out)
+    np.testing.assert_array_equal(out.indices.asnumpy(), [0, 3])
+    dense_out = mx.nd.zeros((5, 2))
+    kv.pushpull("w", g, out=dense_out)
+    np.testing.assert_allclose(dense_out.asnumpy(), g.asnumpy())
+
+
+def test_kvstore_init_with_sparse_value():
+    kv = mx.kvstore.create("local")
+    v = sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), [2]), shape=(4, 3))
+    kv.init("s", v)
+    out = mx.nd.zeros((4, 3))
+    kv.pull("s", out=out)
+    np.testing.assert_allclose(out.asnumpy(), v.asnumpy())
+
+
+def test_kvstore_single_sparse_value_multiple_keys_raises():
+    kv = mx.kvstore.create("local")
+    kv.init("a", mx.nd.zeros((2, 2)))
+    kv.init("b", mx.nd.zeros((2, 2)))
+    v = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [0]), shape=(2, 2))
+    with pytest.raises(ValueError):
+        kv.push(["a", "b"], v)
+
+
+def test_autograd_grad_returns_row_sparse():
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(init="xavier")
+    w = emb.weight.data()
+    x = mx.nd.array(np.array([[1, 3]]), dtype="int32")
+    with mx.autograd.record():
+        loss = (emb(x) ** 2).sum()
+    (g,) = mx.autograd.grad([loss], [w])
+    assert isinstance(g, sparse.RowSparseNDArray)
+    np.testing.assert_array_equal(g.indices.asnumpy(), [1, 3])
+
+
+def test_sparse_grad_copy_is_independent():
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(init="xavier")
+    x = mx.nd.array(np.array([[1, 3]]), dtype="int32")
+    with mx.autograd.record():
+        (emb(x) ** 2).sum().backward()
+    snap = emb.weight.grad().copy()
+    emb.weight.zero_grad()
+    assert snap.nnz == 2  # snapshot survives zero_grad
+    assert emb.weight.grad().nnz == 0
+
+
+def test_sgd_sparse_momentum_change_recompiles():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.0)
+    updater = opt_mod.get_updater(opt)
+    w = mx.nd.ones((4, 2))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [1]), shape=(4, 2))
+    updater(0, g, w)
+    np.testing.assert_allclose(w.asnumpy()[1], 0.9, rtol=1e-5)
+    # hyperparameter mutation must not reuse the stale compiled kernel
+    opt.momentum = 0.9  # lazy momentum path needs a state; use lr change
+    opt.lr = 0.5
+    w2 = mx.nd.ones((4, 2))
+    updater(1, g, w2)
+    np.testing.assert_allclose(w2.asnumpy()[1], 0.5, rtol=1e-5)
