@@ -19,11 +19,28 @@ _initialized = False
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
-    """Multi-host rendezvous (the DMLC tracker analog). Arguments default to
-    the standard JAX env vars; call once per process before any computation."""
+    """Multi-host rendezvous (the DMLC tracker analog). Arguments default
+    to the environment exported by ``tools/launch.py`` — both the native
+    MXTPU_* names and the reference's DMLC_* tracker names are honored —
+    then to jax's own autodetection. Call once per process before any
+    computation."""
+    import os
+
     global _initialized
     if _initialized:
         return
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("MXTPU_COORDINATOR")
+        if coordinator_address is None and "DMLC_PS_ROOT_URI" in env:
+            coordinator_address = (f"{env['DMLC_PS_ROOT_URI']}:"
+                                   f"{env.get('DMLC_PS_ROOT_PORT', '9000')}")
+    if num_processes is None:
+        n = env.get("MXTPU_NUM_WORKERS", env.get("DMLC_NUM_WORKER"))
+        num_processes = int(n) if n is not None else None
+    if process_id is None:
+        r = env.get("MXTPU_WORKER_RANK", env.get("DMLC_WORKER_ID"))
+        process_id = int(r) if r is not None else None
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -22,9 +22,22 @@ import jax.numpy as jnp
 
 class _RandomState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # key is created LAZILY: materializing a device array at import
+        # time would initialize the XLA backend, which must not happen
+        # before jax.distributed.initialize in multi-process jobs
+        self._key = None
         self.counter = 0
         self.providers = []  # trace-time key providers (CachedOp pushes one)
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(0)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
 
 _rs = _RandomState()

@@ -1,0 +1,78 @@
+"""Worker payload for the multi-process distributed tests.
+
+Launched by tools/launch.py with the DMLC/MXTPU rendezvous env; each worker
+initializes jax.distributed on the CPU backend and drives the dist kvstore +
+a cross-process SPMD computation (SURVEY.md §4 'multi-node = multi-process
+on one box'; reference tests/nightly/dist_sync_kvstore.py).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    from incubator_mxnet_tpu.parallel import collectives
+
+    collectives.init_distributed()  # env from tools/launch.py
+
+    import incubator_mxnet_tpu as mx
+
+    rank = jax.process_index()
+    size = jax.process_count()
+    assert size == int(os.environ["MXTPU_NUM_WORKERS"]), size
+
+    # ---- dist kvstore: rank/size, push/pull/pushpull ----------------------
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.rank == rank
+    assert kv.num_workers == size
+
+    kv.init("w", mx.nd.zeros((4,)))
+    grad = mx.nd.ones((4,)) * (rank + 1)
+    out = mx.nd.zeros((4,))
+    kv.pushpull("w", grad, out=out)
+    expect = sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # optimizer-on-kvstore: every worker applies the same aggregated update
+    kv2 = mx.kvstore.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv2.init(0, mx.nd.ones((3,)))
+    kv2.push(0, mx.nd.ones((3,)) * (rank + 1))
+    w = mx.nd.zeros((3,))
+    kv2.pull(0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.5 * expect, rtol=1e-5)
+
+    # ---- cross-process SPMD: global mesh + compiled AllReduce -------------
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())  # spans ALL processes
+    mesh = Mesh(devs, ("data",))
+    n_dev = len(devs)
+    local = np.full((len(jax.local_devices()), 2), rank + 1.0, np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local)
+
+    @jax.jit
+    def global_sum(x):
+        return jnp.sum(x)  # XLA inserts the cross-process AllReduce
+
+    total = float(global_sum(garr))
+    per_proc = [len(jax.local_devices()) * 2 * (r + 1)
+                for r in range(size)]
+    np.testing.assert_allclose(total, sum(per_proc))
+
+    print(f"RANK {rank}/{size} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

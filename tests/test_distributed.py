@@ -1,0 +1,64 @@
+"""Multi-process distributed tests: launcher + dist kvstore + cross-process
+SPMD (SURVEY.md §4 'Distributed' tier — multi-process on one box; reference
+tools/launch.py + tests/nightly/dist_sync_kvstore.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = os.path.join(REPO, "tools", "launch.py")
+PAYLOAD = os.path.join(REPO, "tests", "dist_worker_payload.py")
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the workers must form their own coordination service
+    for k in list(env):
+        if k.startswith(("DMLC_", "MXTPU_COORDINATOR", "MXTPU_NUM_WORKERS",
+                         "MXTPU_WORKER_RANK")):
+            del env[k]
+    env["JAX_PLATFORMS"] = "cpu"
+    # sitecustomize's TPU-plugin registration initializes the XLA backend
+    # at interpreter start, which jax.distributed.initialize forbids;
+    # CPU-only workers don't need the plugin
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # workers import the package from the repo; PRESERVE existing entries
+    # (the axon sitecustomize path must stay on PYTHONPATH)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.parametrize("n", [2])
+def test_launcher_runs_dist_kvstore_workers(n):
+    """launch.py spawns N workers; each drives KVStoreDist push/pull/
+    pushpull and a jitted cross-process AllReduce. Exit 0 everywhere."""
+    proc = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", str(n), "--launcher", "local",
+         sys.executable, PAYLOAD],
+        env=_clean_env(), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    for rank in range(n):
+        assert f"RANK {rank}/{n} OK" in proc.stdout
+
+
+def test_launcher_accepts_reference_cli_shape():
+    """-s servers accepted (ignored with a note), matching reference CLI."""
+    proc = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", "1", "-s", "1",
+         sys.executable, "-c", "print('worker ran')"],
+        env=_clean_env(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "worker ran" in proc.stdout
+    assert "num-servers ignored" in proc.stderr
+
+
+def test_launcher_propagates_failure():
+    proc = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", "2",
+         sys.executable, "-c", "import sys; sys.exit(3)"],
+        env=_clean_env(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3
