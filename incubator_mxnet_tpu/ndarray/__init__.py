@@ -292,3 +292,25 @@ normal = random.normal
 random_normal = random.normal
 random_uniform = random.uniform
 sample_multinomial = random.multinomial
+
+
+# ---------------------------------------------------------------------------
+# nd.contrib submodule (mx.nd.contrib.MultiBoxPrior / box_nms / ... API)
+# ---------------------------------------------------------------------------
+from ..ops import detection as _det  # noqa: F401  (registers bbox ops)
+
+contrib = _ModuleType(__name__ + ".contrib")
+
+smooth_l1 = _wrap("smooth_l1", 1)
+
+for _n, _k in [("box_iou", 2), ("box_nms", 1), ("box_decode", 2),
+               ("box_encode", 4), ("bipartite_matching", 1),
+               ("multibox_prior", 1), ("multibox_target", 3),
+               ("multibox_detection", 3)]:
+    setattr(contrib, _n, _wrap(_n, _k))
+
+contrib.box_non_maximum_suppression = contrib.box_nms
+contrib.MultiBoxPrior = contrib.multibox_prior
+contrib.MultiBoxTarget = contrib.multibox_target
+contrib.MultiBoxDetection = contrib.multibox_detection
+_sys.modules[contrib.__name__] = contrib

@@ -4,4 +4,5 @@ from . import registry
 from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn      # noqa: F401  (registers nn ops)
 from . import random_ops  # noqa: F401  (registers samplers)
+from . import detection  # noqa: F401  (registers detection/bbox ops)
 from .registry import get, list_ops, register  # noqa: F401

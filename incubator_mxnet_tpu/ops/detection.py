@@ -1,0 +1,404 @@
+"""Detection / bounding-box ops.
+
+Capability parity with reference ``src/operator/contrib/multibox_prior.cc``,
+``multibox_target.cc``, ``multibox_detection.cc``, ``bounding_box.cc``
+(box_nms/box_iou/box_encode/box_decode/bipartite_matching) and
+``src/operator/tensor/`` smooth_l1 — the op set behind the SSD-300 north-star
+config (BASELINE.json config[4]).
+
+TPU-native redesign notes:
+- Everything is static-shape. The reference's CUDA kernels emit per-image
+  variable-length results; here matching/NMS produce fixed-size outputs with
+  sentinel ``-1`` rows so the whole pipeline stays inside one XLA program.
+- Greedy bipartite matching and greedy NMS are inherently sequential; they
+  run as ``lax.scan``/``lax.fori_loop`` (compiled loops, not unrolled) over
+  the short axis, with all per-step work vectorised on the VPU.
+- Box-target encoding/decoding is pure elementwise math that XLA fuses into
+  neighbouring ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers
+# ---------------------------------------------------------------------------
+def _to_corner(b):
+    """center (cx, cy, w, h) -> corner (xmin, ymin, xmax, ymax)."""
+    cx, cy, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+def _to_center(b):
+    """corner -> center."""
+    x0, y0, x1, y1 = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0], axis=-1)
+
+
+def _iou_corner(a, b, eps=1e-12):
+    """Pairwise IoU. a: (..., N, 4), b: (..., M, 4) corner format ->
+    (..., N, M)."""
+    ax0, ay0, ax1, ay1 = jnp.split(a[..., :, None, :], 4, axis=-1)
+    bx0, by0, bx1, by1 = jnp.split(b[..., None, :, :], 4, axis=-1)
+    ix = jnp.maximum(0.0, jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0))
+    iy = jnp.maximum(0.0, jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0))
+    inter = (ix * iy)[..., 0]
+    area_a = ((ax1 - ax0) * (ay1 - ay0))[..., 0]
+    area_b = ((bx1 - bx0) * (by1 - by0))[..., 0]
+    return inter / (area_a + area_b - inter + eps)
+
+
+# ---------------------------------------------------------------------------
+# elementwise
+# ---------------------------------------------------------------------------
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Huber-style loss core (reference src/operator/tensor/elemwise_unary_op
+    smooth_l1): f(x) = 0.5 (sx)^2 if |x| < 1/s^2 else |x| - 0.5/s^2."""
+    s2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * data * data,
+                     absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# contrib bounding-box ops
+# ---------------------------------------------------------------------------
+@register("box_iou", differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference src/operator/contrib/bounding_box.cc
+    _contrib_box_iou). lhs (..., N, 4), rhs (..., M, 4) -> (..., N, M)."""
+    if format == "center":
+        lhs, rhs = _to_corner(lhs), _to_corner(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+@register("box_encode", differentiable=False)
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched gt boxes against anchors (reference bounding_box.cc
+    _contrib_box_encode). samples (B, N) in {-1, 0, 1}, matches (B, N) gt
+    indices, anchors (B, N, 4), refs (B, M, 4), corner format.
+    Returns (targets (B, N, 4), masks (B, N, 4))."""
+    m = matches.astype(jnp.int32)
+    g = jnp.take_along_axis(refs, m[..., None], axis=1)  # (B, N, 4)
+    ac = _to_center(anchors)
+    gc = _to_center(g)
+    stds = jnp.asarray(stds, anchors.dtype)
+    means = jnp.asarray(means, anchors.dtype)
+    t = jnp.concatenate([
+        (gc[..., 0:1] - ac[..., 0:1]) / jnp.maximum(ac[..., 2:3], 1e-12),
+        (gc[..., 1:2] - ac[..., 1:2]) / jnp.maximum(ac[..., 3:4], 1e-12),
+        jnp.log(jnp.maximum(gc[..., 2:3], 1e-12)
+                / jnp.maximum(ac[..., 2:3], 1e-12)),
+        jnp.log(jnp.maximum(gc[..., 3:4], 1e-12)
+                / jnp.maximum(ac[..., 3:4], 1e-12))], axis=-1)
+    t = (t - means) / stds
+    mask = (samples > 0.5).astype(anchors.dtype)[..., None]
+    return t * mask, jnp.broadcast_to(mask, t.shape)
+
+
+@register("box_decode", differentiable=False)
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="corner"):
+    """Decode box regressions against anchors (reference bounding_box.cc
+    _contrib_box_decode; stds default to 1.0 like the reference — pass the
+    encode-time stds to invert box_encode). data (B, N, 4),
+    anchors (1, N, 4)."""
+    if format == "corner":
+        a = _to_center(anchors)
+    else:
+        a = anchors
+    stds = jnp.asarray([std0, std1, std2, std3], data.dtype)
+    d = data * stds
+    cx = d[..., 0:1] * a[..., 2:3] + a[..., 0:1]
+    cy = d[..., 1:2] * a[..., 3:4] + a[..., 1:2]
+    dw, dh = d[..., 2:3], d[..., 3:4]
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * a[..., 2:3]
+    h = jnp.exp(dh) * a[..., 3:4]
+    return _to_corner(jnp.concatenate([cx, cy, w, h], axis=-1))
+
+
+@register("bipartite_matching", differentiable=False)
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (reference bounding_box.cc
+    _contrib_bipartite_matching). data (..., N, M) pairwise scores.
+    Returns (row_match (..., N), col_match (..., M)): for each row the
+    matched col index (or -1), and vice versa."""
+    scores = data if not is_ascend else -data
+    thr = threshold if not is_ascend else -threshold
+
+    def match_one(s):
+        n, m = s.shape
+        steps = min(n, m) if topk <= 0 else min(topk, n, m)
+
+        def body(carry, _):
+            s, row, col = carry
+            idx = jnp.argmax(s)
+            i, j = idx // m, idx % m
+            ok = s[i, j] >= thr
+            row = jnp.where(ok, row.at[i].set(j), row)
+            col = jnp.where(ok, col.at[j].set(i), col)
+            s = s.at[i, :].set(-jnp.inf)
+            s = s.at[:, j].set(-jnp.inf)
+            return (s, row, col), None
+
+        init = (s.astype(jnp.float32),
+                jnp.full((n,), -1, jnp.int32), jnp.full((m,), -1, jnp.int32))
+        (_, row, col), _ = lax.scan(body, init, None, length=steps)
+        return row, col
+
+    batch_shape = scores.shape[:-2]
+    flat = scores.reshape((-1,) + scores.shape[-2:])
+    row, col = jax.vmap(match_one)(flat)
+    return (row.reshape(batch_shape + row.shape[-1:]).astype(data.dtype),
+            col.reshape(batch_shape + col.shape[-1:]).astype(data.dtype))
+
+
+def _nms_one(boxes, scores, ids, overlap_thresh, valid, force_suppress):
+    """Greedy NMS over score-sorted candidates. All (N, ...) static shape.
+    Returns keep mask + sort order."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    v = valid[order]
+    cid = ids[order]
+    iou = _iou_corner(b, b)
+    same = jnp.ones((n, n), bool) if force_suppress else \
+        (cid[:, None] == cid[None, :])
+    later = jnp.arange(n)[None, :] > jnp.arange(n)[:, None]
+    sup = (iou > overlap_thresh) & same & later
+
+    def body(i, keep):
+        row = sup[i] & keep[i]
+        return keep & ~row
+
+    keep = lax.fori_loop(0, n, body, v)
+    return keep, order
+
+
+@register("box_nms", aliases=("box_non_maximum_suppression",),
+          differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference bounding_box.cc _contrib_box_nms).
+    data (B, N, K) records; output is score-sorted with suppressed records
+    filled with -1 (static shape — the XLA-friendly analog of the
+    reference's variable-count output)."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    boxes = data[..., coord_start:coord_start + 4]
+    if in_format == "center":
+        boxes = _to_corner(boxes)
+    scores = data[..., score_index]
+    if id_index >= 0:
+        ids = data[..., id_index]
+    else:
+        ids = jnp.zeros_like(scores)
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid = valid & (ids != background_id)
+    if topk > 0:
+        ranked = jnp.where(valid, scores, -jnp.inf)
+        rank = jnp.argsort(jnp.argsort(-ranked, axis=1), axis=1)
+        valid = valid & (rank < topk)
+
+    keep, order = jax.vmap(
+        lambda b, s, c, v: _nms_one(b, s, c, overlap_thresh, v,
+                                    force_suppress))(boxes, scores, ids, valid)
+    sorted_rec = jnp.take_along_axis(data, order[..., None], axis=1)
+    if out_format != in_format:
+        bx = sorted_rec[..., coord_start:coord_start + 4]
+        bx = _to_corner(bx) if out_format == "corner" else _to_center(bx)
+        sorted_rec = sorted_rec.at[..., coord_start:coord_start + 4].set(bx)
+    out = jnp.where(keep[..., None], sorted_rec,
+                    jnp.asarray(-1.0, data.dtype))
+    return out[0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD)
+# ---------------------------------------------------------------------------
+@register("multibox_prior", aliases=("MultiBoxPrior",), differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor-box generation (reference contrib/multibox_prior.cc).
+    data (N, C, H, W) — only the feature-map H, W are read. Per pixel emits
+    ``len(sizes) + len(ratios) - 1`` anchors: (s_i, r_0) for every size plus
+    (s_0, r_j) for j >= 1. Width = s*sqrt(r)*H/W (aspect-corrected so r=1 is
+    square in pixel space), height = s/sqrt(r), normalized coords.
+    Output (1, H*W*A, 4) corner format."""
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+
+    combos = [(s, ratios[0]) for s in sizes] + \
+             [(sizes[0], r) for r in ratios[1:]]
+    ws = jnp.asarray([s * (r ** 0.5) * h / w for s, r in combos],
+                     jnp.float32)
+    hs = jnp.asarray([s / (r ** 0.5) for s, r in combos], jnp.float32)
+
+    cxg = cxg[..., None]                      # (H, W, 1)
+    cyg = cyg[..., None]
+    out = jnp.stack([cxg - ws / 2, cyg - hs / 2,
+                     cxg + ws / 2, cyg + hs / 2], axis=-1)  # (H, W, A, 4)
+    out = out.reshape(1, h * w * len(combos), 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _match_anchors(iou, overlap_threshold):
+    """Reference multibox_target matching: greedy bipartite first (every gt
+    claims its best anchor), then any unmatched anchor with IoU above
+    threshold claims its best gt. iou (N, M) -> match (N,) gt index or -1."""
+    n, m = iou.shape
+
+    def body(carry, _):
+        s, match = carry
+        idx = jnp.argmax(s)
+        i, j = idx // m, idx % m
+        ok = s[i, j] > 1e-12
+        match = jnp.where(ok, match.at[i].set(j), match)
+        s = s.at[i, :].set(-1.0)
+        s = s.at[:, j].set(-1.0)
+        return (s, match), None
+
+    init = (iou.astype(jnp.float32), jnp.full((n,), -1, jnp.int32))
+    (_, match), _ = lax.scan(body, init, None, length=m)
+
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    thresh_match = jnp.where(best_iou >= overlap_threshold, best_gt, -1)
+    return jnp.where(match >= 0, match, thresh_match)
+
+
+@register("multibox_target", aliases=("MultiBoxTarget",),
+          differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Training-target assignment for SSD (reference
+    contrib/multibox_target.cc). anchor (1, N, 4) corner; label (B, M, 5)
+    rows [cls, xmin, ymin, xmax, ymax] padded with -1; cls_pred
+    (B, num_cls+1, N) (read only for hard-negative mining).
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))
+    where cls_target is gt_class+1 for matched anchors, 0 for background
+    and ``ignore_label`` for mined-away negatives."""
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    dtype = anchor.dtype
+
+    def one(lab, pred):
+        gt_valid = lab[:, 0] >= 0                     # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)          # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        match = _match_anchors(iou, overlap_threshold)  # (N,)
+        matched = match >= 0
+        midx = jnp.maximum(match, 0)
+        g = gt_boxes[midx]                            # (N, 4)
+        ac = _to_center(anchors)
+        gc = _to_center(g)
+        v = jnp.asarray(variances, jnp.float32)
+        t = jnp.stack([
+            (gc[:, 0] - ac[:, 0]) / jnp.maximum(ac[:, 2], 1e-12) / v[0],
+            (gc[:, 1] - ac[:, 1]) / jnp.maximum(ac[:, 3], 1e-12) / v[1],
+            jnp.log(jnp.maximum(gc[:, 2], 1e-12)
+                    / jnp.maximum(ac[:, 2], 1e-12)) / v[2],
+            jnp.log(jnp.maximum(gc[:, 3], 1e-12)
+                    / jnp.maximum(ac[:, 3], 1e-12)) / v[3]], axis=-1)
+        box_target = jnp.where(matched[:, None], t, 0.0)
+        box_mask = jnp.broadcast_to(matched[:, None],
+                                    t.shape).astype(jnp.float32)
+
+        cls_target = jnp.where(matched, lab[midx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining: only unmatched anchors whose best IoU is
+            # below negative_mining_thresh are eligible negatives (anchors
+            # with moderate overlap are ignored, not trained as background);
+            # rank eligibles by their max non-background predicted prob and
+            # keep ratio*num_pos hardest as background, ignore the rest
+            # (reference semantics; the ranking statistic here is max
+            # foreground prob rather than the reference's per-anchor CE —
+            # same ordering for softmaxed preds)
+            best_iou = jnp.max(iou, axis=1)
+            eligible = (~matched) & (best_iou < negative_mining_thresh)
+            neg_score = jnp.max(pred[1:, :], axis=0)  # (N,)
+            neg_score = jnp.where(eligible, neg_score, -jnp.inf)
+            num_pos = jnp.sum(matched)
+            quota = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                minimum_negative_samples)
+            rank = jnp.argsort(jnp.argsort(-neg_score))
+            keep_neg = eligible & (rank < quota)
+            cls_target = jnp.where(matched | keep_neg, cls_target,
+                                   float(ignore_label))
+        return (box_target.reshape(-1).astype(dtype),
+                box_mask.reshape(-1).astype(dtype),
+                cls_target.astype(dtype))
+
+    box_t, box_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return box_t, box_m, cls_t
+
+
+@register("multibox_detection", aliases=("MultiBoxDetection",),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS (reference contrib/multibox_detection.cc).
+    cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Output (B, N, 6): [class_id, score, xmin, ymin, xmax, ymax], suppressed
+    rows are all -1, sorted by score."""
+    b = cls_prob.shape[0]
+    n = anchor.shape[1]
+    loc = loc_pred.reshape(b, n, 4)
+    v = variances
+    a = _to_center(anchor)
+    d0 = loc[..., 0:1] * v[0] * a[..., 2:3] + a[..., 0:1]
+    d1 = loc[..., 1:2] * v[1] * a[..., 3:4] + a[..., 1:2]
+    d2 = jnp.exp(loc[..., 2:3] * v[2]) * a[..., 2:3]
+    d3 = jnp.exp(loc[..., 3:4] * v[3]) * a[..., 3:4]
+    boxes = _to_corner(jnp.concatenate([d0, d1, d2, d3], axis=-1))
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # best foreground class per anchor
+    fg = jnp.delete(cls_prob, background_id, axis=1,
+                    assume_unique_indices=True)     # (B, C, N)
+    cls_id = jnp.argmax(fg, axis=1).astype(cls_prob.dtype)   # (B, N)
+    score = jnp.max(fg, axis=1)
+    valid = score > threshold
+
+    records = jnp.concatenate(
+        [cls_id[..., None], score[..., None], boxes], axis=-1)  # (B, N, 6)
+    if nms_topk > 0:
+        rank = jnp.argsort(jnp.argsort(-score, axis=1), axis=1)
+        valid = valid & (rank < nms_topk)
+
+    keep, order = jax.vmap(
+        lambda bx, s, c, va: _nms_one(bx, s, c, nms_threshold, va,
+                                      force_suppress))(boxes, score, cls_id,
+                                                       valid)
+    sorted_rec = jnp.take_along_axis(records, order[..., None], axis=1)
+    return jnp.where(keep[..., None], sorted_rec,
+                     jnp.asarray(-1.0, cls_prob.dtype))

@@ -1,0 +1,285 @@
+"""Detection / bounding-box op tests (numpy as oracle, SURVEY.md §4).
+
+Covers the op set behind the SSD-300 config: multibox_prior/target/detection,
+box_nms, box_iou, box_encode/decode, bipartite_matching, smooth_l1
+(reference tests/python/unittest/test_contrib_operator.py capability)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+
+
+def np_iou(a, b):
+    ix = np.maximum(0, np.minimum(a[2], b[2]) - np.maximum(a[0], b[0]))
+    iy = np.maximum(0, np.minimum(a[3], b[3]) - np.maximum(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-12)
+
+
+def test_smooth_l1_oracle():
+    x = np.random.randn(5, 7).astype(np.float32)
+    for sigma in (1.0, 2.0):
+        got = nd.smooth_l1(nd.array(x), scalar=sigma).asnumpy()
+        s2 = sigma * sigma
+        want = np.where(np.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                        np.abs(x) - 0.5 / s2)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_smooth_l1_grad():
+    x = nd.array(np.array([-2.0, -0.3, 0.3, 2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.smooth_l1(x, scalar=1.0)
+    y.backward(nd.ones_like(y))
+    np.testing.assert_allclose(x.grad.asnumpy(), [-1, -0.3, 0.3, 1],
+                               rtol=1e-6)
+
+
+def test_box_iou_oracle():
+    a = np.abs(np.random.rand(4, 4)).astype(np.float32)
+    a[:, 2:] = a[:, :2] + np.abs(np.random.rand(4, 2)) + 0.05
+    b = np.abs(np.random.rand(3, 4)).astype(np.float32)
+    b[:, 2:] = b[:, :2] + np.abs(np.random.rand(3, 2)) + 0.05
+    got = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    want = np.array([[np_iou(x, y) for y in b] for x in a])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format():
+    a = np.array([[0.5, 0.5, 0.4, 0.4]], np.float32)   # center
+    b = np.array([[0.5, 0.5, 0.8, 0.8]], np.float32)   # center
+    got = nd.contrib.box_iou(nd.array(a), nd.array(b),
+                             format="center").asnumpy()
+    ac = np.array([0.3, 0.3, 0.7, 0.7])
+    bc = np.array([0.1, 0.1, 0.9, 0.9])
+    np.testing.assert_allclose(got[0, 0], np_iou(ac, bc), rtol=1e-5)
+
+
+def test_multibox_prior_counts_and_centers():
+    x = nd.zeros((1, 3, 5, 6))
+    sizes, ratios = (0.4, 0.2), (1.0, 2.0, 0.5)
+    a = nd.contrib.MultiBoxPrior(x, sizes=sizes, ratios=ratios).asnumpy()
+    A = len(sizes) + len(ratios) - 1
+    assert a.shape == (1, 5 * 6 * A, 4)
+    boxes = a[0].reshape(5, 6, A, 4)
+    # center of the (0,0) pixel anchor = (0.5/W, 0.5/H)
+    cx = (boxes[0, 0, 0, 0] + boxes[0, 0, 0, 2]) / 2
+    cy = (boxes[0, 0, 0, 1] + boxes[0, 0, 0, 3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.5 / 6, 0.5 / 5], rtol=1e-5)
+    # first anchor (s=0.4, r=1): w = s*H/W, h = s
+    w = boxes[0, 0, 0, 2] - boxes[0, 0, 0, 0]
+    h = boxes[0, 0, 0, 3] - boxes[0, 0, 0, 1]
+    np.testing.assert_allclose([w, h], [0.4 * 5 / 6, 0.4], rtol=1e-5)
+
+
+def test_multibox_prior_clip():
+    x = nd.zeros((1, 1, 2, 2))
+    a = nd.contrib.MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+def test_multibox_target_matching():
+    # one gt box exactly equal to one anchor: that anchor must match class+1
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9],
+                         [0.0, 0.6, 0.2, 0.8]]], np.float32)
+    label = np.array([[[1, 0.5, 0.5, 0.9, 0.9],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    ct = ct.asnumpy()
+    assert ct.shape == (1, 3)
+    np.testing.assert_array_equal(ct[0], [0, 2, 0])   # class 1 -> target 2
+    bm = bm.asnumpy().reshape(1, 3, 4)
+    np.testing.assert_array_equal(bm[0, 1], [1, 1, 1, 1])
+    np.testing.assert_array_equal(bm[0, 0], [0, 0, 0, 0])
+    # exact match -> zero offsets
+    bt = bt.asnumpy().reshape(1, 3, 4)
+    np.testing.assert_allclose(bt[0, 1], 0, atol=1e-5)
+
+
+def test_multibox_target_encoding_oracle():
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+    label = np.array([[[0, 0.3, 0.25, 0.7, 0.65]]], np.float32)
+    v = (0.1, 0.1, 0.2, 0.2)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.zeros((1, 2, 1)),
+        overlap_threshold=0.3, variances=v)
+    # center-form oracle
+    acx, acy, aw, ah = 0.4, 0.4, 0.4, 0.4
+    gcx, gcy, gw, gh = 0.5, 0.45, 0.4, 0.4
+    want = [(gcx - acx) / aw / v[0], (gcy - acy) / ah / v[1],
+            np.log(gw / aw) / v[2], np.log(gh / ah) / v[3]]
+    np.testing.assert_allclose(bt.asnumpy()[0], want, rtol=1e-4, atol=1e-5)
+    assert ct.asnumpy()[0, 0] == 1.0
+
+
+def test_multibox_target_bipartite_claims_best_anchor():
+    # gt whose IoU with every anchor is below threshold still claims the
+    # best one (bipartite phase)
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    label = np.array([[[2, 0.25, 0.25, 0.55, 0.55]]], np.float32)
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.zeros((1, 4, 2)),
+        overlap_threshold=0.9)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 3.0 and ct[1] == 0.0
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.tile(np.array([[0.0, 0.0, 0.1, 0.1]], np.float32),
+                      (8, 1))[None]
+    anchors = anchors + np.linspace(0, 0.8, 8,
+                                    dtype=np.float32)[None, :, None]
+    label = np.array([[[0, 0.0, 0.0, 0.12, 0.12]]], np.float32)
+    pred = np.zeros((1, 2, 8), np.float32)
+    pred[0, 1] = np.arange(8)  # increasing "hardness"
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(pred),
+        overlap_threshold=0.5, negative_mining_ratio=2.0,
+        negative_mining_thresh=0.5, ignore_label=-1)
+    ct = ct.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_bg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_pos == 1 and n_bg == 2 and n_ign == 5
+    # hardest negatives (largest pred) kept as background
+    assert ct[7] == 0 and ct[6] == 0
+
+
+def test_box_nms_suppression():
+    recs = np.array([[0, 0.9, 0.10, 0.10, 0.50, 0.50],
+                     [0, 0.8, 0.12, 0.12, 0.52, 0.52],   # overlaps #0
+                     [1, 0.7, 0.60, 0.60, 0.90, 0.90],
+                     [0, 0.0, 0.00, 0.00, 0.00, 0.00]],  # invalid score
+                    np.float32)
+    out = nd.contrib.box_nms(nd.array(recs), overlap_thresh=0.5,
+                             valid_thresh=0.01, coord_start=2,
+                             score_index=1, id_index=0).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)
+    assert (out[1] == -1).all()          # suppressed duplicate
+    assert out[2, 0] == 1                # other class survives
+    assert (out[3] == -1).all()
+
+
+def test_box_nms_force_suppress_and_class_aware():
+    # same boxes, different class ids: class-aware NMS keeps both
+    recs = np.array([[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                     [1, 0.8, 0.1, 0.1, 0.5, 0.5]], np.float32)
+    keep = nd.contrib.box_nms(nd.array(recs), overlap_thresh=0.5,
+                              id_index=0).asnumpy()
+    assert (keep[1] != -1).any()
+    gone = nd.contrib.box_nms(nd.array(recs), overlap_thresh=0.5,
+                              id_index=0, force_suppress=True).asnumpy()
+    assert (gone[1] == -1).all()
+
+
+def test_box_nms_batch_and_topk():
+    recs = np.random.rand(2, 20, 6).astype(np.float32)
+    recs[..., 2:4] = recs[..., 2:4] * 0.4
+    recs[..., 4:6] = recs[..., 2:4] + 0.3
+    out = nd.contrib.box_nms(nd.array(recs), overlap_thresh=0.7,
+                             topk=5, id_index=0).asnumpy()
+    assert out.shape == (2, 20, 6)
+    # no more than topk survivors per image
+    assert ((out[..., 1] > 0).sum(axis=1) <= 5).all()
+
+
+def test_box_decode_roundtrip():
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.7]]], np.float32)
+    gt = np.array([[[0.25, 0.15, 0.7, 0.8]]], np.float32)
+    samples = np.ones((1, 1), np.float32)
+    matches = np.zeros((1, 1), np.float32)
+    t, m = nd.contrib.box_encode(nd.array(samples), nd.array(matches),
+                                 nd.array(anchors), nd.array(gt))
+    back = nd.contrib.box_decode(t, nd.array(anchors), std0=0.1, std1=0.1,
+                                 std2=0.2, std3=0.2).asnumpy()
+    np.testing.assert_allclose(back, gt, rtol=1e-4, atol=1e-5)
+
+
+def test_box_decode_default_stds_identity():
+    # reference _contrib_box_decode defaults stds to 1.0 (stds pre-folded
+    # into the regression targets)
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+    data = np.zeros((1, 1, 4), np.float32)
+    back = nd.contrib.box_decode(nd.array(data), nd.array(anchors)).asnumpy()
+    np.testing.assert_allclose(back, anchors, rtol=1e-5)
+
+
+def test_box_nms_topk_ignores_invalid():
+    # a background box must not consume a topk slot (valid boxes ranked only)
+    recs = np.array([[0, 0.9, 0.10, 0.10, 0.50, 0.50],
+                     [1, 0.8, 0.60, 0.60, 0.90, 0.90],
+                     [1, 0.7, 0.05, 0.55, 0.35, 0.95]], np.float32)
+    out = nd.contrib.box_nms(nd.array(recs), overlap_thresh=0.5,
+                             id_index=0, background_id=0, topk=2).asnumpy()
+    kept_scores = sorted(out[out[:, 1] > 0][:, 1].tolist(), reverse=True)
+    assert kept_scores == pytest.approx([0.8, 0.7])
+
+
+def test_multibox_target_mining_thresh_excludes_moderate_iou():
+    # anchor 1 has moderate IoU (>= mining thresh, < overlap threshold):
+    # it must be ignored, never selected as a hard negative
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.05, 0.05, 0.45, 0.45],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    label = np.array([[[0, 0.0, 0.0, 0.4, 0.4]]], np.float32)
+    pred = np.zeros((1, 2, 3), np.float32)
+    pred[0, 1] = [0.0, 9.0, 1.0]  # anchor 1 is the "hardest" negative
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(pred),
+        overlap_threshold=0.9, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5, ignore_label=-1)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0          # matched (bipartite)
+    assert ct[1] == -1.0         # moderate IoU -> ignored despite hardness
+    assert ct[2] == 0.0          # the only eligible negative
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]]], np.float32)
+    row, col = nd.contrib.bipartite_matching(nd.array(score), threshold=1e-12)
+    row, col = row.asnumpy()[0], col.asnumpy()[0]
+    # greedy: global max 0.6 -> (0,1); next 0.3 -> (2,0); row 1 unmatched
+    np.testing.assert_array_equal(row, [1, -1, 0])
+    np.testing.assert_array_equal(col, [2, 0])
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.2, 0.8],      # background
+                          [0.8, 0.7, 0.1],      # class 0
+                          [0.1, 0.1, 0.1]]], np.float32)  # class 1
+    loc = np.zeros((1, 12), np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        nms_threshold=0.5, threshold=0.15).asnumpy()
+    assert out.shape == (1, 3, 6)
+    # top record: class 0, score .8, box = anchor 0 (zero offsets)
+    np.testing.assert_allclose(out[0, 0], [0, 0.8, 0.1, 0.1, 0.5, 0.5],
+                               rtol=1e-5, atol=1e-6)
+    # anchor 1 suppressed by NMS (same class, IoU > .5)
+    assert (out[0, 1] == -1).all()
+    # anchor 2 below threshold -> dropped
+    assert (out[0, 2] == -1).all()
+
+
+def test_multibox_detection_offsets_applied():
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+    cls_prob = np.array([[[0.1], [0.9]]], np.float32)
+    v = (0.1, 0.1, 0.2, 0.2)
+    # shift center by +0.1 in x: offset = 0.1/aw/v0
+    loc = np.array([[0.1 / 0.4 / v[0], 0, 0, 0]], np.float32).reshape(1, 4)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        variances=v).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 2:], [0.3, 0.2, 0.7, 0.6],
+                               rtol=1e-4, atol=1e-5)
