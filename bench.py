@@ -1,85 +1,259 @@
-"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+"""Benchmarks: all five BASELINE.json configs, one JSON line each.
 
-BASELINE.json config[1] — the reference's north-star metric is matching A100
-images/sec on ResNet-50 ImageNet training. Anchor: ~800 img/s per A100 with
-AMP (BASELINE.md ◊ row, unverified memory anchor). ``vs_baseline`` is
-ours / 800.
+Every config runs the fused SPMD training path (forward + backward +
+optimizer in one XLA computation, bf16 compute) on whatever devices are
+visible — the single real chip under the driver. Batches are synthetic and
+pre-placed on device (sharded over the data axis) so the numbers measure
+chip throughput, not the host feeder.
 
-Runs the fused SPMD training path (forward+backward+SGD in one XLA
-computation, bf16 compute with fp32 master-weight-free SGD) on whatever
-devices are visible — the single real chip under the driver.
+``vs_baseline`` = ours / anchor. Anchors are UNVERIFIED memory anchors
+(BASELINE.md ◊ rows — no published numbers were retrievable in this
+environment): ResNet-50 ~800 img/s/A100 AMP (NGC-era), BERT-base phase-1
+~220 seq/s/A100, LSTM PTB medium ~20k tokens/s (cuDNN V100-era), SSD-300
+VGG16 ~180 img/s/A100, MLP/MNIST ~500k img/s (trivially host-bound on GPU).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The headline metric (ResNet-50, the north-star row) prints LAST.
 """
 
 from __future__ import annotations
 
+import gc
 import json
 import time
 
 import numpy as np
 
-A100_ANCHOR_IMGS_PER_SEC = 800.0
+ANCHORS = {
+    "mlp": 500_000.0,
+    "lstm_ptb": 20_000.0,
+    "bert_base": 220.0,
+    "ssd300": 180.0,
+    "resnet50": 800.0,
+}
+
+WARMUP = 3
+ITERS = 10
 
 
-def main():
+def _place(mesh, arr, dtype=None):
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    x = jnp.asarray(arr, dtype) if dtype is not None else jnp.asarray(arr)
+    return jax.device_put(x, sharding)
+
+
+def _timed_steps(trainer, args):
+    """warmup + timed loop; returns wall seconds for ITERS steps.
+    device_get forces a full roundtrip — the experimental PJRT tunnel's
+    block_until_ready is not a reliable fence."""
+    import jax
+
+    loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    for _ in range(WARMUP - 1):
+        loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    return time.perf_counter() - t0
+
+
+def bench_mlp():
+    """config[0]: Gluon MLP / MNIST."""
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    n_dev = len(jax.devices())
+    batch = 2048 * n_dev
+    net = nn.HybridSequential()
+    net.add(nn.Dense(512, activation="relu"),
+            nn.Dense(512, activation="relu"), nn.Dense(10))
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, 784), dtype="bfloat16"))
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    x = _place(mesh, np.random.rand(batch, 784).astype(np.float32),
+               jnp.bfloat16)
+    y = _place(mesh, np.random.randint(0, 10, (batch,)).astype(np.float32))
+    dt = _timed_steps(trainer, (x, y))
+    return (batch * ITERS / dt / n_dev, "images/sec/chip",
+            "mlp_mnist_train_throughput_per_chip", "mlp")
+
+
+def bench_lstm_ptb():
+    """config[3]: LSTM PTB medium (2x650, seq 35, batch 20) — the cuDNN-RNN
+    capability over lax.scan."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn, rnn
+
+    n_dev = len(jax.devices())
+    V, E, H, T, B = 10000, 650, 650, 35, 20 * n_dev
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(V, E),
+            rnn.LSTM(H, num_layers=2, layout="NTC", input_size=E),
+            nn.Dense(V, flatten=False, in_units=H))
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, T), dtype="int32"))
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 1.0, "clip_gradient": 0.25}, mesh=mesh)
+    data = np.random.randint(0, V, (B, T + 1))
+    x = _place(mesh, data[:, :-1].astype(np.int32))
+    y = _place(mesh, data[:, 1:].astype(np.float32))
+    dt = _timed_steps(trainer, (x, y))
+    return (B * T * ITERS / dt / n_dev, "tokens/sec/chip",
+            "lstm_ptb_train_throughput_per_chip", "lstm_ptb")
+
+
+def bench_bert():
+    """config[2]: BERT-base pretraining (MLM+NSP, seq 128)."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, models, parallel
+
+    n_dev = len(jax.devices())
+    B, T, V = 24 * n_dev, 128, 30522
+    net = models.get_bert("bert_12_768_12", vocab_size=V, dropout=0.0,
+                          max_length=512)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, T), dtype="int32"),
+        mx.nd.zeros((2, T), dtype="int32"),
+        mx.nd.array(np.full((2,), T), dtype="int32"))
+
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def pretrain_loss(seq_out, pooled, mlm_scores, nsp_scores,
+                      mlm_label, nsp_label):
+        return ce(mlm_scores, mlm_label).mean() + \
+            ce(nsp_scores, nsp_label).mean()
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, pretrain_loss, "sgd", {"learning_rate": 1e-4, "momentum": 0.9},
+        mesh=mesh)
+    tok = _place(mesh, np.random.randint(0, V, (B, T)).astype(np.int32))
+    seg = _place(mesh, np.zeros((B, T), np.int32))
+    vl = _place(mesh, np.full((B,), T, np.int32))
+    mlm_y = _place(mesh, np.random.randint(0, V, (B, T)).astype(np.float32))
+    nsp_y = _place(mesh, np.random.randint(0, 2, (B,)).astype(np.float32))
+    dt = _timed_steps(trainer, ([tok, seg, vl], [mlm_y, nsp_y]))
+    return (B * ITERS / dt / n_dev, "sequences/sec/chip",
+            "bert_base_pretrain_throughput_per_chip", "bert_base")
+
+
+def bench_ssd():
+    """config[4]: SSD-300 VOC with AMP (bf16 tower) — target assignment
+    (multibox_target) fused into the jitted step."""
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import models, parallel
+    from incubator_mxnet_tpu import ndarray as nd
+    from incubator_mxnet_tpu.models import SSDMultiBoxLoss
+
+    n_dev = len(jax.devices())
+    B = 16 * n_dev
+    net = models.get_ssd(num_classes=20)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, 3, 300, 300), dtype="bfloat16"))
+
+    box_loss = SSDMultiBoxLoss()
+
+    def ssd_loss(cls_pred, loc_pred, anchors, label):
+        a32 = anchors.astype("float32")
+        bt, bm, ct = nd.contrib.MultiBoxTarget(
+            a32, label, cls_pred.transpose((0, 2, 1)).astype("float32"),
+            negative_mining_ratio=3.0, ignore_label=-1)
+        return box_loss(cls_pred.astype("float32"),
+                        loc_pred.astype("float32"), ct, bt, bm)
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, ssd_loss, "sgd",
+        {"learning_rate": 1e-3, "momentum": 0.9}, mesh=mesh)
+    x = _place(mesh, np.random.rand(B, 3, 300, 300).astype(np.float32),
+               jnp.bfloat16)
+    label = np.full((B, 4, 5), -1.0, np.float32)
+    rs = np.random.RandomState(0)
+    for i in range(B):
+        cx, cy = rs.uniform(0.3, 0.7, 2)
+        w, h = rs.uniform(0.2, 0.4, 2)
+        label[i, 0] = [rs.randint(20), cx - w / 2, cy - h / 2,
+                       cx + w / 2, cy + h / 2]
+    y = _place(mesh, label)
+    dt = _timed_steps(trainer, (x, y))
+    return (B * ITERS / dt / n_dev, "images/sec/chip",
+            "ssd300_train_throughput_per_chip", "ssd300")
+
+
+def bench_resnet():
+    """config[1]: ResNet-50 — the north-star headline metric."""
+    import jax
+    import jax.numpy as jnp
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import gluon, parallel
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
     n_dev = len(jax.devices())
-    batch_per_chip = 128
-    batch = batch_per_chip * n_dev
-
+    batch = 128 * n_dev
     net = vision.resnet50_v1(classes=1000)
     net.initialize(init="xavier")
     net.cast("bfloat16")
-    net(mx.nd.zeros((2, 3, 224, 224), dtype="bfloat16"))  # resolve shapes
+    net(mx.nd.zeros((2, 3, 224, 224), dtype="bfloat16"))
 
     mesh = parallel.make_mesh({"data": -1})
     trainer = parallel.SPMDTrainer(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(),
-        "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    x = _place(mesh, np.random.rand(batch, 3, 224, 224).astype(np.float32),
+               jnp.bfloat16)
+    y = _place(mesh, np.random.randint(0, 1000, (batch,)).astype(np.float32))
+    dt = _timed_steps(trainer, (x, y))
+    return (batch * ITERS / dt / n_dev, "images/sec/chip",
+            "resnet50_v1_train_throughput_per_chip", "resnet50")
 
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
 
-    # place the synthetic batch on device ONCE (sharded over the data axis);
-    # a host->device transfer per step would swamp the measurement
-    sharding = NamedSharding(mesh, PartitionSpec("data"))
-    x_host = np.random.rand(batch, 3, 224, 224).astype(np.float32)
-    x = jax.device_put(jnp.asarray(x_host, jnp.bfloat16), sharding)
-    y = jax.device_put(
-        jnp.asarray(np.random.randint(0, 1000, (batch,)), jnp.float32),
-        sharding)
-    x = mx.nd.NDArray(x)
-    y = mx.nd.NDArray(y)
-
-    # warmup: compile + 2 steps (device_get forces a full roundtrip — the
-    # experimental PJRT tunnel's block_until_ready is not a reliable fence)
-    loss = trainer.step(x, y)
-    float(jax.device_get(loss))
-    for _ in range(2):
-        loss = trainer.step(x, y)
-    float(jax.device_get(loss))
-
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.step(x, y)
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-
-    imgs_per_sec = batch * iters / dt
-    per_chip = imgs_per_sec / n_dev
-    print(json.dumps({
-        "metric": "resnet50_v1_train_throughput_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / A100_ANCHOR_IMGS_PER_SEC, 4),
-    }))
+def main():
+    # headline (resnet) runs and prints last
+    for fn in (bench_mlp, bench_lstm_ptb, bench_bert, bench_ssd,
+               bench_resnet):
+        try:
+            value, unit, metric, key = fn()
+            print(json.dumps({
+                "metric": metric,
+                "value": round(value, 2),
+                "unit": unit,
+                "vs_baseline": round(value / ANCHORS[key], 4),
+            }), flush=True)
+        except Exception as e:  # one failing config must not hide the rest
+            print(json.dumps({
+                "metric": fn.__name__, "value": 0, "unit": "error",
+                "vs_baseline": 0, "error": str(e)[:200]}), flush=True)
+        gc.collect()
 
 
 if __name__ == "__main__":

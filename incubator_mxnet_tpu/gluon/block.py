@@ -416,24 +416,29 @@ class CachedOp:
         if entry is None:
             holder = {}
             pure = self._make_pure(params, len(inputs), training, holder)
-            # trace once eagerly to learn the vjp residual structure
-            out_flat, vjp_fn = jax.vjp(pure, *args)
-            res_leaves, vjp_treedef = jax.tree_util.tree_flatten(vjp_fn)
 
+            # the vjp residual tree structure must be captured from the
+            # SAME trace that produces the residual leaves: an eager
+            # jax.vjp can fold input-independent values (e.g. anchor
+            # tables) into constants while the jitted trace keeps them as
+            # residuals, so the treedef is recorded inside fwd_split's jit
+            # trace and read back when bwd is traced (strictly after the
+            # first fwd call)
             def fwd_split(*a):
                 o, v = jax.vjp(pure, *a)
-                return o, jax.tree_util.tree_flatten(v)[0]
+                flat, td = jax.tree_util.tree_flatten(v)
+                holder["vjp_treedef"] = td
+                return o, flat
 
             def bwd(res_flat, cts):
-                f = jax.tree_util.tree_unflatten(vjp_treedef, res_flat)
+                f = jax.tree_util.tree_unflatten(holder["vjp_treedef"],
+                                                 res_flat)
                 return f(cts)
 
             entry = {"fwd": jax.jit(fwd_split), "bwd": jax.jit(bwd),
                      "holder": holder, "pure": pure}
             self._bwd_cache[key] = entry
-            res_flat = res_leaves
-        else:
-            out_flat, res_flat = entry["fwd"](*args)
+        out_flat, res_flat = entry["fwd"](*args)
 
         holder = entry["holder"]
         out, all_nds = self._wrap_outputs(out_flat, holder, inputs,

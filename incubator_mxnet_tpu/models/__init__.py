@@ -6,3 +6,5 @@ this framework's capability surface (BASELINE.json configs 2 and 4).
 
 from .transformer import (BERTEncoder, BERTModel, MultiHeadAttention,
                           PositionwiseFFN, TransformerEncoderCell, get_bert)
+from .ssd import (SSD, SSDMultiBoxLoss, VGGAtrousBase, get_ssd,
+                  ssd_300_vgg16_atrous_voc)

@@ -19,6 +19,18 @@ from ..gluon.block import HybridBlock
 from ..gluon.nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
 
 
+def _length_mask(lengths, t_k):
+    """(B,) valid lengths -> (B, 1, 1, Tk) boolean-ish key mask."""
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import invoke
+
+    return invoke(
+        lambda vl: (jnp.arange(t_k)[None, None, None, :]
+                    < vl.reshape(-1, 1, 1, 1)).astype(jnp.float32),
+        [lengths], name="attn_mask", differentiable=False)
+
+
 class MultiHeadAttention(HybridBlock):
     """Self/cross attention (B, T, C) with ``num_heads`` (GluonNLP
     ``MultiHeadAttentionCell`` capability)."""
@@ -47,7 +59,7 @@ class MultiHeadAttention(HybridBlock):
                          self._units // self._heads).transpose(
                              (0, 2, 1, 3))
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, lengths=None):
         from .. import ndarray as F
 
         q = self._split(self.query(x))
@@ -57,7 +69,18 @@ class MultiHeadAttention(HybridBlock):
             from ..parallel.ring_attention import ring_attention_nd
 
             out = ring_attention_nd(q, k, v, mask=mask)
+        elif self._impl == "pallas" and mask is None:
+            # the Pallas kernel natively handles per-sample key lengths
+            # (BERT valid_length); arbitrary dense masks fall through below
+            if lengths is None:
+                out = F.flash_attention(q, k, v)
+            else:
+                out = F.invoke_op("flash_attention", q, k, v, lengths)
         else:
+            # pallas path supports causal/lengths/no-mask only; arbitrary
+            # dense masks use the XLA-fused reference chain
+            if lengths is not None and mask is None:
+                mask = _length_mask(lengths, k.shape[2])
             out = F.scaled_dot_product_attention(q, k, v, mask=mask)
         b, h, t, d = out.shape
         out = out.transpose((0, 2, 1, 3)).reshape(b, t, self._units)
@@ -97,8 +120,8 @@ class TransformerEncoderCell(HybridBlock):
             self.ffn = PositionwiseFFN(units, hidden_size, dropout)
             self.ln2 = LayerNorm(in_channels=units)
 
-    def forward(self, x, mask=None):
-        h = self.ln1(x + self.dropout(self.attention(x, mask)))
+    def forward(self, x, mask=None, lengths=None):
+        h = self.ln1(x + self.dropout(self.attention(x, mask, lengths)))
         return self.ln2(h + self.ffn(h))
 
 
@@ -113,9 +136,9 @@ class BERTEncoder(HybridBlock):
                                                dropout, attention_impl))
         self._num_layers = num_layers
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, lengths=None):
         for i in range(self._num_layers):
-            x = getattr(self, f"layer{i}")(x, mask)
+            x = getattr(self, f"layer{i}")(x, mask, lengths)
         return x
 
 
@@ -185,13 +208,10 @@ class BERTModel(HybridBlock):
                + self.token_type_embed(segment_ids))
         emb = self.embed_dropout(self.embed_ln(emb))
 
-        mask = None
-        if valid_length is not None:
-            mask = invoke(
-                lambda vl: (jnp.arange(t)[None, None, None, :]
-                            < vl.reshape(-1, 1, 1, 1)).astype(jnp.float32),
-                [valid_length], name="attn_mask", differentiable=False)
-        seq = self.encoder(emb, mask)
+        # valid_length flows down as per-sample lengths: the pallas impl
+        # consumes it natively in-kernel, the xla impl expands it to a
+        # dense key mask at the attention core
+        seq = self.encoder(emb, None, valid_length)
         outputs = [seq]
         if self._use_pooler:
             pooled = self.pooler(seq.slice_axis(1, 0, 1).squeeze(1))

@@ -314,3 +314,76 @@ contrib.MultiBoxPrior = contrib.multibox_prior
 contrib.MultiBoxTarget = contrib.multibox_target
 contrib.MultiBoxDetection = contrib.multibox_detection
 _sys.modules[contrib.__name__] = contrib
+
+
+# ---------------------------------------------------------------------------
+# nd.linalg submodule + extended op surface (linalg/misc/rnn families)
+# ---------------------------------------------------------------------------
+from ..ops import linalg as _linalg  # noqa: F401
+from ..ops import misc as _misc      # noqa: F401
+from ..ops import rnn_op as _rnn_op  # noqa: F401
+
+linalg = _ModuleType(__name__ + ".linalg")
+for _n, _k in [("linalg_gemm", 3), ("linalg_gemm2", 2), ("linalg_syrk", 1),
+               ("linalg_potrf", 1), ("linalg_potri", 1), ("linalg_trmm", 2),
+               ("linalg_trsm", 2), ("linalg_sumlogdiag", 1),
+               ("linalg_gelqf", 1), ("linalg_syevd", 1),
+               ("linalg_inverse", 1), ("linalg_det", 1),
+               ("linalg_slogdet", 1), ("linalg_extractdiag", 1),
+               ("linalg_makediag", 1), ("linalg_extracttrian", 1),
+               ("linalg_maketrian", 1)]:
+    _w = _wrap(_n, _k)
+    setattr(_this, _n, _w)
+    setattr(linalg, _n.replace("linalg_", ""), _w)
+_sys.modules[linalg.__name__] = linalg
+
+for _n, _k in [("degrees", 1), ("radians", 1), ("round", 1),
+               ("logical_not", 1), ("erfc", 1), ("log_sigmoid", 1),
+               ("batch_take", 2), ("index_array", 1), ("moments", 1),
+               ("UpSampling", 1), ("BilinearResize2D", 1),
+               ("GridGenerator", 1), ("BilinearSampler", 2),
+               ("SpatialTransformer", 2), ("ROIPooling", 2),
+               ("ROIAlign", 2), ("MakeLoss", 1),
+               ("LinearRegressionOutput", 2), ("MAERegressionOutput", 2),
+               ("LogisticRegressionOutput", 2)]:
+    setattr(_this, _n, _wrap(_n, _k))
+
+SwapAxis = _wrap("swapaxes_op", 1)
+
+
+def ravel_multi_index(data, shape):
+    return invoke(_registry.get("ravel_multi_index").fn, [data],
+                  dict(shape=tuple(shape)), name="ravel_multi_index",
+                  differentiable=False)
+
+
+def unravel_index(data, shape):
+    return invoke(_registry.get("unravel_index").fn, [data],
+                  dict(shape=tuple(shape)), name="unravel_index",
+                  differentiable=False)
+
+
+def RNN(data, parameters, state, state_cell=None, **kwargs):
+    args = [data, parameters, state] + (
+        [state_cell] if state_cell is not None else [])
+
+    def fn(*arrs, **kw):
+        sc = arrs[3] if len(arrs) > 3 else None
+        return _registry.get("RNN").fn(arrs[0], arrs[1], arrs[2], sc, **kw)
+
+    return invoke(fn, args, kwargs, name="RNN")
+
+
+# contrib aliases for the spatial/roi family (reference namespaces them
+# under both mx.nd and mx.nd.contrib across versions)
+contrib.BilinearResize2D = _this.BilinearResize2D
+contrib.ROIAlign = _this.ROIAlign
+contrib.index_array = _this.index_array
+
+
+# ---------------------------------------------------------------------------
+# pallas custom-kernel surface
+# ---------------------------------------------------------------------------
+from ..ops import pallas_attention as _pallas_attention  # noqa: F401
+
+flash_attention = _wrap("flash_attention", 3)

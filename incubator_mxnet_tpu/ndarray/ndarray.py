@@ -421,6 +421,15 @@ class NDArray:
 
     # -- arithmetic --------------------------------------------------------
     def _binop(self, other, fn, name, reverse=False):
+        if isinstance(other, (int, float, bool)) and not isinstance(
+                other, NDArray):
+            # scalar operand: fold it into the op so jnp's weak-type
+            # promotion preserves the array dtype (reference scalar-op
+            # semantics — bf16 * 2.0 stays bf16, not float32)
+            s = other
+            if reverse:
+                return invoke(lambda a: fn(s, a), [self], name=name)
+            return invoke(lambda a: fn(a, s), [self], name=name)
         o = as_nd(other, ctx=self._ctx)
         a, b = (o, self) if reverse else (self, o)
         return invoke(fn, [a, b], name=name)

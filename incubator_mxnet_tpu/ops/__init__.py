@@ -5,4 +5,8 @@ from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn      # noqa: F401  (registers nn ops)
 from . import random_ops  # noqa: F401  (registers samplers)
 from . import detection  # noqa: F401  (registers detection/bbox ops)
+from . import linalg  # noqa: F401  (registers linalg family)
+from . import misc    # noqa: F401  (registers indexing/spatial/loss ops)
+from . import rnn_op  # noqa: F401  (registers fused RNN op)
+from . import pallas_attention  # noqa: F401  (registers flash_attention)
 from .registry import get, list_ops, register  # noqa: F401

@@ -211,21 +211,35 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
     valid = scores > valid_thresh
     if id_index >= 0 and background_id >= 0:
         valid = valid & (ids != background_id)
-    if topk > 0:
-        ranked = jnp.where(valid, scores, -jnp.inf)
-        rank = jnp.argsort(jnp.argsort(-ranked, axis=1), axis=1)
-        valid = valid & (rank < topk)
+
+    n = data.shape[1]
+    rec = data
+    if 0 < topk < n:
+        # gather the topk valid candidates FIRST so the O(K²) IoU matrix
+        # is bounded by topk, not N (N=8732 for SSD-300 would be ~300MB
+        # per image) — mirrors the reference's nms_topk pre-slice
+        masked = jnp.where(valid, scores, -jnp.inf)
+        order0 = jnp.argsort(-masked, axis=1)[:, :topk]      # (B, K)
+        boxes = jnp.take_along_axis(boxes, order0[..., None], axis=1)
+        scores = jnp.take_along_axis(scores, order0, axis=1)
+        ids = jnp.take_along_axis(ids, order0, axis=1)
+        valid = jnp.take_along_axis(valid, order0, axis=1)
+        rec = jnp.take_along_axis(data, order0[..., None], axis=1)
 
     keep, order = jax.vmap(
         lambda b, s, c, v: _nms_one(b, s, c, overlap_thresh, v,
                                     force_suppress))(boxes, scores, ids, valid)
-    sorted_rec = jnp.take_along_axis(data, order[..., None], axis=1)
+    sorted_rec = jnp.take_along_axis(rec, order[..., None], axis=1)
     if out_format != in_format:
         bx = sorted_rec[..., coord_start:coord_start + 4]
         bx = _to_corner(bx) if out_format == "corner" else _to_center(bx)
         sorted_rec = sorted_rec.at[..., coord_start:coord_start + 4].set(bx)
     out = jnp.where(keep[..., None], sorted_rec,
                     jnp.asarray(-1.0, data.dtype))
+    if 0 < topk < n:
+        pad = jnp.full((out.shape[0], n - topk, out.shape[2]), -1.0,
+                       out.dtype)
+        out = jnp.concatenate([out, pad], axis=1)
     return out[0] if squeeze else out
 
 
@@ -391,14 +405,27 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
     records = jnp.concatenate(
         [cls_id[..., None], score[..., None], boxes], axis=-1)  # (B, N, 6)
-    if nms_topk > 0:
-        rank = jnp.argsort(jnp.argsort(-score, axis=1), axis=1)
-        valid = valid & (rank < nms_topk)
+    if 0 < nms_topk < n:
+        # bound the NMS IoU matrix by nms_topk (see box_nms)
+        masked = jnp.where(valid, score, -jnp.inf)
+        order0 = jnp.argsort(-masked, axis=1)[:, :nms_topk]
+        boxes = jnp.take_along_axis(boxes, order0[..., None], axis=1)
+        score = jnp.take_along_axis(score, order0, axis=1)
+        cls_id = jnp.take_along_axis(cls_id, order0, axis=1)
+        valid = jnp.take_along_axis(valid, order0, axis=1)
+        records_sel = jnp.take_along_axis(records, order0[..., None], axis=1)
+    else:
+        records_sel = records
 
     keep, order = jax.vmap(
         lambda bx, s, c, va: _nms_one(bx, s, c, nms_threshold, va,
                                       force_suppress))(boxes, score, cls_id,
                                                        valid)
-    sorted_rec = jnp.take_along_axis(records, order[..., None], axis=1)
-    return jnp.where(keep[..., None], sorted_rec,
-                     jnp.asarray(-1.0, cls_prob.dtype))
+    sorted_rec = jnp.take_along_axis(records_sel, order[..., None], axis=1)
+    out = jnp.where(keep[..., None], sorted_rec,
+                    jnp.asarray(-1.0, cls_prob.dtype))
+    if 0 < nms_topk < n:
+        pad = jnp.full((out.shape[0], n - nms_topk, out.shape[2]), -1.0,
+                       out.dtype)
+        out = jnp.concatenate([out, pad], axis=1)
+    return out

@@ -1,0 +1,177 @@
+"""Linear-algebra operator family.
+
+Capability parity with reference ``src/operator/tensor/la_op.cc``
+(``mx.nd.linalg.*``: gemm/gemm2/potrf/potri/trmm/trsm/sumlogdiag/syrk/
+gelqf/syevd/inverse/det/slogdet/extractdiag/makediag/extracttrian/
+maketrian). All ops are batched over leading dimensions exactly like the
+reference (operate on the trailing two axes).
+
+TPU-native: everything lowers through jax.numpy.linalg / lax.linalg — XLA
+maps the triangular solves and factorizations to its native TPU
+implementations and the matmuls to the MXU; there is no LAPACK/cuSOLVER
+dispatch layer to rebuild.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _t(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+@register("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """alpha * op(A) op(B) + beta * C (reference la_op.cc gemm)."""
+    if axis != -2:
+        raise NotImplementedError(
+            "linalg_gemm: only the default axis=-2 (trailing matrix dims) "
+            "is implemented; transpose your batch layout instead")
+    a_ = _t(a) if transpose_a else a
+    b_ = _t(b) if transpose_b else b
+    return alpha * jnp.matmul(a_, b_) + beta * c
+
+
+@register("linalg_syrk")
+def linalg_syrk(a, transpose=False, alpha=1.0):
+    """alpha * A Aᵀ (or AᵀA if transpose)."""
+    a_ = _t(a) if transpose else a
+    return alpha * jnp.matmul(a_, _t(a_))
+
+
+@register("linalg_potrf")
+def linalg_potrf(a):
+    """Cholesky factor L (lower) of a SPD matrix: A = L Lᵀ."""
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri")
+def linalg_potri(a):
+    """Inverse of the SPD matrix B from its Cholesky factor A:
+    out = B⁻¹ where B = A Aᵀ (reference potri semantics)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("linalg_trmm")
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply: alpha * op(A) B (or B op(A))."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    tri = _t(tri) if transpose else tri
+    out = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * out
+
+
+@register("linalg_trsm")
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular solve: out = alpha * op(A)⁻¹ B (or B op(A)⁻¹)."""
+    if rightside:
+        # X = B op(A)^-1  <=>  op(A)^T X^T = B^T
+        x = jax.scipy.linalg.solve_triangular(
+            a, _t(b), trans=0 if transpose else 1, lower=lower)
+        return alpha * _t(x)
+    x = jax.scipy.linalg.solve_triangular(
+        a, b, trans=1 if transpose else 0, lower=lower)
+    return alpha * x
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    """Sum of log of the diagonal (log-det of a Cholesky factor)."""
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("linalg_gelqf")
+def linalg_gelqf(a):
+    """LQ factorization A = L Q with Q orthonormal rows (reference gelqf).
+    Returns (Q, L)."""
+    q_t, r_t = jnp.linalg.qr(_t(a))
+    # A^T = QR  =>  A = R^T Q^T = L Q'
+    return _t(q_t), _t(r_t)
+
+
+@register("linalg_syevd")
+def linalg_syevd(a):
+    """Symmetric eigendecomposition: returns (U, L) with A = Uᵀ diag(L) U
+    (reference syevd row-eigenvector convention)."""
+    w, v = jnp.linalg.eigh(a)
+    return _t(v), w
+
+
+@register("linalg_inverse", aliases=("inverse",))
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det", aliases=("det",))
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet", aliases=("slogdet",))
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(d, offset=0):
+    base = d.shape[-1] + abs(offset)
+    out_shape = d.shape[:-1] + (base, base)
+    out = jnp.zeros(out_shape, d.dtype)
+    idx = jnp.arange(d.shape[-1])
+    rows = idx + max(0, -offset)
+    cols = idx + max(0, offset)
+    return out.at[..., rows, cols].set(d)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(a, offset=0, lower=True):
+    """Extract a triangle (incl. ``offset`` diagonals) as a packed vector,
+    row-major, reference la_op semantics."""
+    import numpy as _np
+
+    n = a.shape[-1]
+    if lower:
+        rows, cols = _np.tril_indices(n, k=offset)
+    else:
+        rows, cols = _np.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(d, offset=0, lower=True):
+    """Inverse of extracttrian: unpack a vector into a triangular matrix."""
+    import numpy as _np
+
+    k = d.shape[-1]
+    # solve n (n+1)/2 +- ... : find n such that count(n, offset) == k
+    n = 1
+    while True:
+        if lower:
+            cnt = len(_np.tril_indices(n, k=offset)[0])
+        else:
+            cnt = len(_np.triu_indices(n, k=offset)[0])
+        if cnt == k:
+            break
+        n += 1
+        if n > 4096:
+            raise ValueError("cannot infer matrix size from packed length")
+    rows, cols = (_np.tril_indices(n, k=offset) if lower
+                  else _np.triu_indices(n, k=offset))
+    out = jnp.zeros(d.shape[:-1] + (n, n), d.dtype)
+    return out.at[..., rows, cols].set(d)

@@ -1,0 +1,420 @@
+"""Misc operator parity: indexing helpers, regression outputs, unary
+stragglers, upsampling/resize, spatial transformer family.
+
+Capability parity with reference ``src/operator/tensor/indexing_op.cc``
+(batch_take, ravel/unravel), ``src/operator/regression_output.cc``
+(Linear/MAE/LogisticRegressionOutput), ``src/operator/make_loss.cc``,
+``src/operator/nn/upsampling.cc``, ``src/operator/bilinear_sampler.cc``,
+``src/operator/spatial_transformer.cc``, ``src/operator/grid_generator.cc``,
+``src/operator/roi_pooling.cc`` and ``src/operator/contrib/roi_align.cc``
+(SURVEY.md §2.1 operator library).
+
+TPU notes: gather-heavy ops (batch_take, ROI pooling) become one_hot-free
+``take_along_axis``/dynamic-slice patterns XLA vectorizes well; bilinear
+sampling is 4 gathers + lerp on the VPU; everything static-shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# unary stragglers
+# ---------------------------------------------------------------------------
+@register("degrees")
+def degrees(x):
+    return jnp.degrees(x)
+
+
+@register("radians")
+def radians(x):
+    return jnp.radians(x)
+
+
+@register("round")
+def round_(x):
+    return jnp.round(x)
+
+
+@register("logical_not")
+def logical_not(x):
+    return (x == 0).astype(x.dtype if x.dtype.kind == "f" else jnp.float32)
+
+
+@register("erfc")
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+@register("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register("swapaxes_op", aliases=("SwapAxis",))
+def swapaxes_op(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("moments")
+def moments(x, axes=None, keepdims=False):
+    """Reference src/operator/nn/moments.cc: returns (mean, var)."""
+    ax = tuple(axes) if axes is not None else None
+    return (jnp.mean(x, axis=ax, keepdims=keepdims),
+            jnp.var(x, axis=ax, keepdims=keepdims))
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+@register("batch_take")
+def batch_take(x, indices):
+    """Per-row element pick (reference indexing_op.cc batch_take):
+    out[i] = x[i, indices[i]]."""
+    idx = indices.astype(jnp.int32).reshape(-1, 1)
+    return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(data, shape=None):
+    """data (ndim, N) -> flat indices (N,) (reference ravel.cc)."""
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(data, shape=None):
+    """flat indices (N,) -> coordinates (ndim, N)."""
+    out = []
+    rem = data.astype(jnp.int32)
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    for st, d in zip(reversed(strides), shape):
+        out.append((rem // st) % d)
+    return jnp.stack(out, axis=0).astype(data.dtype)
+
+
+@register("index_array", differentiable=False)
+def index_array(data, axes=None):
+    """Per-element coordinate tensor (reference contrib index_array)."""
+    shape = data.shape
+    axes = tuple(axes) if axes is not None else tuple(range(data.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    sel = [grids[a] for a in axes]
+    return jnp.stack(sel, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# regression outputs / loss wrappers (reference regression_output.cc,
+# make_loss.cc): forward is identity-ish; backward is the loss gradient
+# ---------------------------------------------------------------------------
+def _regression_output(kind):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        if kind == "logistic":
+            return jax.nn.sigmoid(data)
+        return data
+
+    def fwd(data, label, grad_scale):
+        out = core(data, label, grad_scale)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        del g  # reference: loss-op; head gradient treated as 1
+        out, label = res
+        lab = label.reshape(out.shape).astype(out.dtype)
+        if kind == "mae":
+            grad = jnp.sign(out - lab)
+        else:  # linear & logistic share (pred - label)
+            grad = out - lab
+        grad = grad * grad_scale
+        lab_ct = jnp.zeros_like(label) if label.dtype.kind == "f" else None
+        if lab_ct is None:
+            import numpy as _onp
+
+            lab_ct = _onp.zeros(label.shape, dtype=jax.dtypes.float0)
+        return grad, lab_ct
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_lin_core = _regression_output("linear")
+_mae_core = _regression_output("mae")
+_log_core = _regression_output("logistic")
+
+
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _lin_core(data, label, float(grad_scale))
+
+
+@register("MAERegressionOutput", aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _mae_core(data, label, float(grad_scale))
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _log_core(data, label, float(grad_scale))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _make_loss_core(data, grad_scale, normalization, valid_thresh):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, normalization, valid_thresh):
+    return data, data
+
+
+def _make_loss_bwd(grad_scale, normalization, valid_thresh, data, g):
+    # reference make_loss.cc: backward seeds ones * grad_scale, divided by
+    # batch size ('batch') or the runtime count of valid (> valid_thresh)
+    # elements ('valid')
+    scale = jnp.asarray(grad_scale, jnp.float32)
+    if normalization == "batch":
+        scale = scale / data.shape[0]
+    elif normalization == "valid":
+        n_valid = jnp.maximum(jnp.sum(data > valid_thresh), 1)
+        scale = scale / n_valid.astype(jnp.float32)
+    return (jnp.full(data.shape, scale, g.dtype),)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, normalization="null", valid_thresh=0.0):
+    return _make_loss_core(data, float(grad_scale), str(normalization),
+                           float(valid_thresh))
+
+
+# ---------------------------------------------------------------------------
+# resize / upsampling
+# ---------------------------------------------------------------------------
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(x, scale=2, sample_type="nearest", num_filter=0):
+    """Reference src/operator/nn/upsampling.cc (nearest; bilinear via
+    resize). NCHW."""
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return bilinear_resize2d(x, height=h * scale, width=w * scale)
+
+
+@register("BilinearResize2D", aliases=("bilinear_resize_2d",))
+def bilinear_resize2d(x, height=None, width=None, scale_height=None,
+                      scale_width=None, align_corners=True):
+    """Reference src/operator/contrib/bilinear_resize.cc (NCHW; the
+    align_corners=True convention of the reference's default mode)."""
+    n, c, h, w = x.shape
+    oh = height if height is not None else int(h * scale_height)
+    ow = width if width is not None else int(w * scale_width)
+    if align_corners and oh > 1 and ow > 1:
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+    else:
+        ys = (jnp.arange(oh) + 0.5) * h / oh - 0.5
+        xs = (jnp.arange(ow) + 0.5) * w / ow - 0.5
+    return _bilinear_gather(x, ys, xs)
+
+
+def _bilinear_gather(x, ys, xs):
+    """Separable bilinear gather on a (N, C, H, W) tensor."""
+    n, c, h, w = x.shape
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    wy = jnp.clip(ys - y0, 0.0, 1.0).astype(x.dtype)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wx = jnp.clip(xs - x0, 0.0, 1.0).astype(x.dtype)
+    top = x[:, :, y0, :] * (1 - wy)[None, None, :, None] + \
+        x[:, :, y1, :] * wy[None, None, :, None]      # (N, C, OH, W)
+    out = top[:, :, :, x0] * (1 - wx) + top[:, :, :, x1] * wx
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spatial transformer family
+# ---------------------------------------------------------------------------
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Reference src/operator/grid_generator.cc. affine: data (N, 6) ->
+    grid (N, 2, H, W) of (x, y) sampling coords in [-1, 1]; warp: data is
+    already a flow field (N, 2, H, W) added to the identity grid."""
+    th, tw = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, th)
+        xs = jnp.linspace(-1.0, 1.0, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, base)              # (N, 2, HW)
+        return out.reshape(n, 2, th, tw)
+    # warp: flow + identity grid, normalized
+    n, _, h, w = data.shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    # reference warp semantics: flow is in pixels
+    fx = data[:, 0] * 2.0 / max(w - 1, 1)
+    fy = data[:, 1] * 2.0 / max(h - 1, 1)
+    return jnp.stack([gx[None] + fx, gy[None] + fy], axis=1)
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Reference src/operator/bilinear_sampler.cc: sample data (N, C, H, W)
+    at grid (N, 2, OH, OW) of normalized (x, y) in [-1, 1]; zero padding
+    outside."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0    # (N, OH, OW)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (gx - x0).astype(data.dtype)
+    wy = (gy - y0).astype(data.dtype)
+
+    def gather2(yi, xi):
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0)
+                 & (yi <= h - 1)).astype(data.dtype)
+        xc = jnp.clip(xi, 0, w - 1)
+        yc = jnp.clip(yi, 0, h - 1)
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, -1)
+        idxb = jnp.broadcast_to(idx[:, None, :], (n, c, idx.shape[-1]))
+        vals = jnp.take_along_axis(flat, idxb, axis=2)
+        return vals.reshape(n, c, *xi.shape[1:]) * valid[:, None]
+
+    v00 = gather2(y0, x0)
+    v01 = gather2(y0, x1)
+    v10 = gather2(y1, x0)
+    v11 = gather2(y1, x1)
+    wxb = wx[:, None]
+    wyb = wy[:, None]
+    return (v00 * (1 - wxb) * (1 - wyb) + v01 * wxb * (1 - wyb)
+            + v10 * (1 - wxb) * wyb + v11 * wxb * wyb)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Reference src/operator/spatial_transformer.cc = GridGenerator +
+    BilinearSampler fused."""
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Reference src/operator/roi_pooling.cc: max-pool each ROI to a fixed
+    (ph, pw). rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords.
+    Static-shape: per-cell masked max over the full feature map."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = data[bidx]                      # (C, H, W)
+
+        def cell(py, px):
+            ys0 = jnp.floor(y1 + py * bh)
+            ys1 = jnp.ceil(y1 + (py + 1) * bh)
+            xs0 = jnp.floor(x1 + px * bw)
+            xs1 = jnp.ceil(x1 + (px + 1) * bw)
+            my = (ys >= ys0) & (ys < jnp.maximum(ys1, ys0 + 1))
+            mx = (xs >= xs0) & (xs < jnp.maximum(xs1, xs0 + 1))
+            mask = my[:, None] & mx[None, :]
+            neg = jnp.asarray(-jnp.inf, data.dtype)
+            masked = jnp.where(mask[None], img, neg)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(mask), val,
+                             jnp.zeros_like(val))
+
+        rows = []
+        for py in range(ph):
+            cols = [cell(py, px) for px in range(pw)]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)       # (C, PH, PW)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("ROIAlign", aliases=("roi_align",))
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """Reference src/operator/contrib/roi_align.cc (Mask R-CNN ROIAlign):
+    average of bilinear samples per cell; no quantization."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    sr = max(1, int(sample_ratio))
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = data[bidx]                      # (C, H, W)
+
+        # sample grid: (PH*sr, PW*sr) bilinear points, mean-pooled per cell
+        iy = (jnp.arange(ph * sr) + 0.5) / sr      # in bin units
+        ix = (jnp.arange(pw * sr) + 0.5) / sr
+        sy = y1 + iy * bh                           # (PH*sr,)
+        sx = x1 + ix * bw
+
+        y0 = jnp.clip(jnp.floor(sy), 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        wy = jnp.clip(sy - y0, 0.0, 1.0).astype(data.dtype)
+        x0 = jnp.clip(jnp.floor(sx), 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wx = jnp.clip(sx - x0, 0.0, 1.0).astype(data.dtype)
+        top = img[:, y0, :] * (1 - wy)[None, :, None] + \
+            img[:, y1i, :] * wy[None, :, None]
+        samp = top[:, :, x0] * (1 - wx) + top[:, :, x1i] * wx  # (C,PHsr,PWsr)
+        samp = samp.reshape(c, ph, sr, pw, sr)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)

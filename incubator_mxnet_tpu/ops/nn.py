@@ -455,8 +455,9 @@ def embedding(indices, weight, input_dim=None, output_dim=None,
 def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
                                  causal=False):
     """Batched multi-head attention core: q,k,v (B, H, T, D). XLA fuses this
-    chain; a Pallas flash-attention kernel replaces it for long sequences
-    (see parallel/ring_attention)."""
+    chain; the Pallas flash-attention kernel (ops/pallas_attention.py,
+    ``mx.nd.flash_attention`` / ``MultiHeadAttention(attention_impl=
+    'pallas')``) replaces it for long sequences."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s

@@ -1,0 +1,115 @@
+"""Fused RNN operator — the ``mx.nd.RNN`` surface.
+
+Capability parity with reference ``src/operator/rnn.cc`` / ``rnn-inl.h``
+(the cuDNN fused RNN behind ``gluon.rnn.LSTM``): one op runs a multi-layer,
+optionally bidirectional RNN/LSTM/GRU over a (T, N, I) sequence, taking all
+weights as ONE packed 1-D parameter vector in the cuDNN layout — all
+i2h/h2h weight matrices in layer order first (forward dir then reverse dir
+per layer), then all biases in the same order.
+
+TPU-native: unpacking is pure static slicing (free at trace time); the
+recurrence itself reuses the same hoisted-input-projection ``lax.scan`` core
+as gluon.rnn (rnn_layer._run_direction), so XLA compiles one on-chip loop
+per direction with MXU-batched gate matmuls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    """Total packed parameter count (reference GetRnnParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    total = 0
+    for l in range(num_layers):
+        ins = input_size if l == 0 else state_size * d
+        total += d * (g * state_size * ins + g * state_size * state_size
+                      + 2 * g * state_size)
+    return total
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    """Split the packed vector into per-(layer, dir) (wi, wh, bi, bh)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    weights, biases = [], []
+    off = 0
+    for l in range(num_layers):
+        ins = input_size if l == 0 else h * d
+        for _ in range(d):
+            wi = params[off:off + g * h * ins].reshape(g * h, ins)
+            off += g * h * ins
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            weights.append((wi, wh))
+    for l in range(num_layers):
+        for _ in range(d):
+            bi = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            biases.append((bi, bh))
+    return [(wi, wh, bi, bh) for (wi, wh), (bi, bh)
+            in zip(weights, biases)]
+
+
+@register("RNN", aliases=("rnn",), needs_rng=True)
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None, layout="TNC",
+        training=False, rng=None):
+    """Fused RNN (reference src/operator/rnn.cc). data (T, N, I) [TNC],
+    parameters packed 1-D, state (L*D, N, H), state_cell likewise (lstm).
+    Dropout ``p`` applies between layers in training (reference cuDNN
+    dropout-descriptor semantics). Returns out (T, N, H*D), or
+    (out, h_n[, c_n]) if state_outputs."""
+    import jax as _jax
+
+    from ..gluon.rnn.rnn_layer import _run_direction
+
+    if layout == "NTC":
+        data = jnp.swapaxes(data, 0, 1)
+    t, n, input_size = data.shape
+    h = int(state_size)
+    d = 2 if bidirectional else 1
+    packs = _unpack(parameters, mode, input_size, h, num_layers,
+                    bidirectional)
+
+    x = data
+    hs, cs = [], []
+    for l in range(num_layers):
+        if l > 0 and p > 0.0 and training and rng is not None:
+            rng, sub = _jax.random.split(rng)
+            keep = 1.0 - p
+            mask = _jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype)
+            x = x * mask / keep
+        outs_dir, h_dir, c_dir = [], [], []
+        for di in range(d):
+            wi, wh, bi, bh = packs[l * d + di]
+            h0 = state[l * d + di]
+            c0 = state_cell[l * d + di] if state_cell is not None \
+                else jnp.zeros_like(h0)
+            outs, hT, cT = _run_direction(mode, x, h0, c0, wi, wh, bi, bh,
+                                          reverse=(di == 1))
+            outs_dir.append(outs)
+            h_dir.append(hT)
+            c_dir.append(cT)
+        x = outs_dir[0] if d == 1 else jnp.concatenate(outs_dir, axis=-1)
+        hs.extend(h_dir)
+        cs.extend(c_dir)
+
+    out = x if layout == "TNC" else jnp.swapaxes(x, 0, 1)
+    if not state_outputs:
+        return out
+    h_n = jnp.stack(hs, axis=0)
+    if mode == "lstm":
+        return out, h_n, jnp.stack(cs, axis=0)
+    return out, h_n

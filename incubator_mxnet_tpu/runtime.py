@@ -32,7 +32,9 @@ def _detect():
     feats["CPU"] = True
     feats["CUDA"] = "gpu" in platforms or "cuda" in platforms
     feats["XLA"] = True
-    feats["PALLAS"] = True
+    # compiled Pallas kernels need a real TPU backend (ops/pallas_attention);
+    # on CPU the kernels still run via the Pallas interpreter
+    feats["PALLAS"] = _pallas_available()
     feats["BF16"] = True
     feats["INT64_TENSOR_SIZE"] = jax.config.jax_enable_x64
     feats["DIST_KVSTORE"] = True      # jax.distributed-backed kvstore facade
@@ -43,6 +45,12 @@ def _detect():
     feats["RECORDIO_NATIVE"] = _native_recordio_available()
     feats["AMP"] = True
     return feats
+
+
+def _pallas_available() -> bool:
+    from .ops.pallas_attention import pallas_available
+
+    return pallas_available()
 
 
 def _has_module(name: str) -> bool:

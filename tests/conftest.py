@@ -26,6 +26,10 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running training tests")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Seed discipline: every test runs with a logged, overridable seed

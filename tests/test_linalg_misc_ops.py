@@ -1,0 +1,375 @@
+"""Oracle tests for the linalg family, misc indexing/spatial ops, and the
+fused RNN op (reference test_operator.py linalg/spatial sections;
+numpy/scipy as oracle, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _spd(b, n, rng):
+    a = rng.rand(b, n, n).astype(np.float32)
+    return a @ a.transpose(0, 2, 1) + 3 * np.eye(n, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+def test_linalg_gemm_oracle():
+    rng = np.random.RandomState(0)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    b = rng.rand(2, 4, 5).astype(np.float32)
+    c = rng.rand(2, 3, 5).astype(np.float32)
+    got = nd.linalg.gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(got, 2.0 * (a @ b) + 0.5 * c, rtol=1e-5)
+    got_t = nd.linalg.gemm(
+        nd.array(a.transpose(0, 2, 1)), nd.array(b), nd.array(c),
+        transpose_a=True).asnumpy()
+    np.testing.assert_allclose(got_t, a @ b + c, rtol=1e-5)
+
+
+def test_linalg_syrk():
+    rng = np.random.RandomState(1)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    got = nd.linalg.syrk(nd.array(a), alpha=1.5).asnumpy()
+    np.testing.assert_allclose(got, 1.5 * a @ a.transpose(0, 2, 1),
+                               rtol=1e-5)
+    got_t = nd.linalg.syrk(nd.array(a), transpose=True).asnumpy()
+    np.testing.assert_allclose(got_t, a.transpose(0, 2, 1) @ a, rtol=1e-5)
+
+
+def test_linalg_potrf_potri():
+    rng = np.random.RandomState(2)
+    spd = _spd(3, 4, rng)
+    L = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.transpose(0, 2, 1), spd,
+                               rtol=1e-4, atol=1e-4)
+    assert (np.triu(L, 1) == 0).all()
+    inv = nd.linalg.potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-2,
+                               atol=1e-3)
+
+
+def test_linalg_trmm_trsm():
+    rng = np.random.RandomState(3)
+    tri = np.tril(rng.rand(4, 4).astype(np.float32)) + \
+        2 * np.eye(4, dtype=np.float32)
+    b = rng.rand(4, 4).astype(np.float32)
+    got = nd.linalg.trmm(nd.array(tri), nd.array(b), alpha=2.0).asnumpy()
+    np.testing.assert_allclose(got, 2.0 * tri @ b, rtol=1e-5)
+    got = nd.linalg.trmm(nd.array(tri), nd.array(b),
+                         rightside=True).asnumpy()
+    np.testing.assert_allclose(got, b @ tri, rtol=1e-5)
+    got = nd.linalg.trmm(nd.array(tri), nd.array(b),
+                         transpose=True).asnumpy()
+    np.testing.assert_allclose(got, tri.T @ b, rtol=1e-5)
+
+    for rightside in (False, True):
+        for transpose in (False, True):
+            x = nd.linalg.trsm(nd.array(tri), nd.array(b),
+                               rightside=rightside,
+                               transpose=transpose).asnumpy()
+            opa = tri.T if transpose else tri
+            want = b @ np.linalg.inv(opa) if rightside else \
+                np.linalg.inv(opa) @ b
+            np.testing.assert_allclose(x, want, rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_sumlogdiag_det_slogdet_inverse():
+    rng = np.random.RandomState(4)
+    spd = _spd(2, 3, rng)
+    got = nd.linalg.sumlogdiag(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(
+        got, np.log(np.diagonal(spd, axis1=-2, axis2=-1)).sum(-1),
+        rtol=1e-5)
+    np.testing.assert_allclose(nd.linalg.det(nd.array(spd)).asnumpy(),
+                               np.linalg.det(spd), rtol=1e-4)
+    sign, logdet = nd.linalg.slogdet(nd.array(spd))
+    s, l = np.linalg.slogdet(spd)
+    np.testing.assert_allclose(sign.asnumpy(), s, rtol=1e-5)
+    np.testing.assert_allclose(logdet.asnumpy(), l, rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg.inverse(nd.array(spd)).asnumpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_gelqf_syevd():
+    rng = np.random.RandomState(5)
+    a = rng.rand(3, 5).astype(np.float32)
+    q, l = nd.linalg.gelqf(nd.array(a))
+    q, l = q.asnumpy(), l.asnumpy()
+    np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(q @ q.T, np.eye(3), rtol=1e-4, atol=1e-5)
+    assert (np.triu(l, 1) == 0).all()
+
+    spd = _spd(2, 4, rng)
+    u, w = nd.linalg.syevd(nd.array(spd))
+    u, w = u.asnumpy(), w.asnumpy()
+    rec = u.transpose(0, 2, 1) @ (w[..., None] * u)
+    np.testing.assert_allclose(rec, spd, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_diag_trian_pack():
+    rng = np.random.RandomState(6)
+    a = rng.rand(2, 4, 4).astype(np.float32)
+    d = nd.linalg.extractdiag(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(d, np.diagonal(a, axis1=-2, axis2=-1))
+    d1 = nd.linalg.extractdiag(nd.array(a), offset=1).asnumpy()
+    np.testing.assert_allclose(d1, np.diagonal(a, offset=1, axis1=-2,
+                                               axis2=-1))
+    back = nd.linalg.makediag(nd.array(d)).asnumpy()
+    np.testing.assert_allclose(np.diagonal(back, axis1=-2, axis2=-1), d)
+
+    packed = nd.linalg.extracttrian(nd.array(a)).asnumpy()
+    assert packed.shape == (2, 10)
+    unpacked = nd.linalg.maketrian(nd.array(packed)).asnumpy()
+    np.testing.assert_allclose(unpacked, np.tril(a), rtol=1e-6)
+
+
+def test_linalg_potrf_gradient():
+    rng = np.random.RandomState(7)
+    spd = _spd(1, 3, rng)
+    check_numeric_gradient(lambda x: nd.linalg.sumlogdiag(
+        nd.linalg.potrf(x)), [nd.array(spd)], rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# misc / indexing
+# ---------------------------------------------------------------------------
+def test_unary_stragglers():
+    x = np.array([-1.5, -0.2, 0.7, 2.0], np.float32)
+    np.testing.assert_allclose(nd.degrees(nd.array(x)).asnumpy(),
+                               np.degrees(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.radians(nd.array(x)).asnumpy(),
+                               np.radians(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.round(nd.array(x)).asnumpy(), np.round(x))
+    np.testing.assert_allclose(
+        nd.logical_not(nd.array(np.array([0.0, 1.0, -2.0], np.float32))
+                       ).asnumpy(), [1, 0, 0])
+    from scipy import special
+
+    np.testing.assert_allclose(nd.erfc(nd.array(x)).asnumpy(),
+                               special.erfc(x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.log_sigmoid(nd.array(x)).asnumpy(),
+                               np.log(1 / (1 + np.exp(-x))), rtol=1e-5)
+
+
+def test_reverse_swapaxis_moments():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(nd.reverse(nd.array(x), axis=1).asnumpy(),
+                               x[:, ::-1])
+    np.testing.assert_allclose(
+        nd.SwapAxis(nd.array(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+    m, v = nd.moments(nd.array(x), axes=(0, 2))
+    np.testing.assert_allclose(m.asnumpy(), x.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.var(axis=(0, 2)), rtol=1e-5)
+
+
+def test_batch_take_and_ravel():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], np.float32)
+    np.testing.assert_allclose(
+        nd.batch_take(nd.array(x), nd.array(idx)).asnumpy(), [0, 5, 7, 9])
+    flat = nd.ravel_multi_index(
+        nd.array(np.array([[1, 2], [0, 1]], np.float32)),
+        shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(flat, [4, 9])
+    coords = nd.unravel_index(nd.array(np.array([4, 9], np.float32)),
+                              shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(coords, [[1, 2], [0, 1]])
+
+
+def test_index_array():
+    x = nd.zeros((2, 3))
+    out = nd.index_array(x).asnumpy()
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out[1, 2], [1, 2])
+    out_ax = nd.index_array(x, axes=(1,)).asnumpy()
+    np.testing.assert_allclose(out_ax[..., 0], [[0, 1, 2]] * 2)
+
+
+# ---------------------------------------------------------------------------
+# regression outputs / MakeLoss
+# ---------------------------------------------------------------------------
+def test_regression_outputs():
+    rng = np.random.RandomState(8)
+    data = rng.randn(4, 3).astype(np.float32)
+    label = rng.randn(4, 3).astype(np.float32)
+    d = nd.array(data)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, nd.array(label))
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), data)
+    np.testing.assert_allclose(d.grad.asnumpy(), data - label, rtol=1e-5)
+
+    d = nd.array(data)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(d, nd.array(label))
+    out.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(), np.sign(data - label))
+
+    d = nd.array(data)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(d, nd.array(label))
+    out.backward()
+    sig = 1 / (1 + np.exp(-data))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    np.testing.assert_allclose(d.grad.asnumpy(), sig - label, rtol=1e-5)
+
+
+def test_make_loss():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(x, grad_scale=3.0)
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), [1, 2])
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3])
+
+
+# ---------------------------------------------------------------------------
+# resize / spatial
+# ---------------------------------------------------------------------------
+def test_upsampling_nearest():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = nd.UpSampling(nd.array(x), scale=2,
+                        sample_type="nearest").asnumpy()
+    want = np.repeat(np.repeat(x, 2, 2), 2, 3)
+    np.testing.assert_allclose(out, want)
+
+
+def test_bilinear_resize_align_corners():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = nd.BilinearResize2D(nd.array(x), height=3, width=3).asnumpy()
+    want = np.array([[0, 0.5, 1], [1, 1.5, 2], [2, 2.5, 3]], np.float32)
+    np.testing.assert_allclose(out[0, 0], want, rtol=1e-5)
+
+
+def test_grid_generator_identity_affine():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)  # identity transform
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(3, 3)).asnumpy()
+    assert grid.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(grid[0, 0], [[-1, 0, 1]] * 3, atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1],
+                               [[-1] * 3, [0] * 3, [1] * 3], atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(9)
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(4, 4))
+    out = nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    # translate by one pixel in x: out[..., j] = x[..., j+1] (zero at edge)
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # normalized shift: +2/(W-1) * ... affine x' = x + 2/3
+    theta = np.array([[1, 0, 2.0 / 3.0, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(4, 4)).asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :3], x[0, 0, :, 1:], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_roi_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 1, 1],     # top-left 2x2 region
+                     [0, 2, 2, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(1, 1),
+                        spatial_scale=1.0).asnumpy()
+    assert out.shape == (2, 1, 1, 1)
+    assert out[0, 0, 0, 0] == 5.0      # max of x[0:2, 0:2]
+    assert out[1, 0, 0, 0] == 15.0     # max of x[2:4, 2:4]
+
+
+def test_roi_align_center():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 1, 1, 2, 2]], np.float32)
+    out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(1, 1),
+                      spatial_scale=1.0, sample_ratio=1).asnumpy()
+    # single sample at roi center (1.5, 1.5): bilinear of 5,6,9,10 = 7.5
+    np.testing.assert_allclose(out[0, 0, 0, 0], 7.5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused RNN op
+# ---------------------------------------------------------------------------
+def _pack_params(layer_params):
+    """[(wi, wh, bi, bh), ...] -> packed 1-D cuDNN-layout vector."""
+    ws = [w for wi, wh, _, _ in layer_params for w in (wi.ravel(),
+                                                       wh.ravel())]
+    bs = [b for _, _, bi, bh in layer_params for b in (bi, bh)]
+    return np.concatenate(ws + bs)
+
+
+def test_rnn_op_matches_gluon_lstm():
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+    from incubator_mxnet_tpu.ops.rnn_op import rnn_param_size
+
+    rng = np.random.RandomState(10)
+    T, N, I, H = 5, 3, 4, 6
+    layer = grnn.LSTM(H, num_layers=1, layout="TNC", input_size=I)
+    layer.initialize(init="xavier")
+    x = nd.array(rng.rand(T, N, I).astype(np.float32))
+    want = layer(x).asnumpy()
+
+    p = {k: v.data().asnumpy() for k, v in layer.collect_params().items()}
+    pre = layer.prefix
+    packed = _pack_params([(p[pre + "l0_i2h_weight"],
+                            p[pre + "l0_h2h_weight"],
+                            p[pre + "l0_i2h_bias"],
+                            p[pre + "l0_h2h_bias"])])
+    assert packed.size == rnn_param_size("lstm", I, H)
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    got = nd.RNN(x, nd.array(packed), h0, c0, state_size=H, num_layers=1,
+                 mode="lstm").asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_op_bidirectional_gru_shapes_and_states():
+    from incubator_mxnet_tpu.ops.rnn_op import rnn_param_size
+
+    rng = np.random.RandomState(11)
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    n_par = rnn_param_size("gru", I, H, num_layers=L, bidirectional=True)
+    params = nd.array(rng.uniform(-0.1, 0.1, (n_par,)).astype(np.float32))
+    x = nd.array(rng.rand(T, N, I).astype(np.float32))
+    h0 = nd.zeros((2 * L, N, H))
+    out, hn = nd.RNN(x, params, h0, state_size=H, num_layers=L, mode="gru",
+                     bidirectional=True, state_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hn.shape == (2 * L, N, H)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_rnn_op_gradient_flows():
+    from incubator_mxnet_tpu.ops.rnn_op import rnn_param_size
+
+    rng = np.random.RandomState(12)
+    T, N, I, H = 3, 2, 3, 4
+    n_par = rnn_param_size("rnn_tanh", I, H)
+    params = nd.array(rng.uniform(-0.3, 0.3, (n_par,)).astype(np.float32))
+    params.attach_grad()
+    x = nd.array(rng.rand(T, N, I).astype(np.float32))
+    h0 = nd.zeros((1, N, H))
+    with autograd.record():
+        out = nd.RNN(x, params, h0, state_size=H, num_layers=1,
+                     mode="rnn_tanh")
+        loss = (out * out).sum()
+    loss.backward()
+    g = params.grad.asnumpy()
+    assert g.shape == (n_par,) and np.abs(g).max() > 0

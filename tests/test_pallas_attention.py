@@ -1,0 +1,108 @@
+"""Pallas flash-attention kernel tests (the RTC/custom-kernel tier,
+SURVEY.md §2.1). On the CPU test mesh the kernel runs through the Pallas
+interpreter; the same code path compiles on a real TPU."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.models import MultiHeadAttention
+
+
+@pytest.mark.parametrize("shape,causal", [
+    ((2, 3, 64, 32), False),
+    ((1, 2, 100, 16), True),     # non-multiple-of-block T exercises padding
+    ((1, 1, 256, 64), True),
+])
+def test_flash_matches_xla_sdpa(shape, causal):
+    rng = np.random.RandomState(0)
+    b, h, t, d = shape
+    q = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    k = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    v = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    out = nd.flash_attention(q, k, v, causal=causal).asnumpy()
+    ref = nd.scaled_dot_product_attention(q, k, v, causal=causal).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_grad_matches_xla():
+    rng = np.random.RandomState(1)
+    q = nd.array(rng.randn(1, 2, 32, 16).astype(np.float32))
+    k = nd.array(rng.randn(1, 2, 32, 16).astype(np.float32))
+    v = nd.array(rng.randn(1, 2, 32, 16).astype(np.float32))
+    grads = []
+    for fn in (nd.flash_attention, nd.scaled_dot_product_attention):
+        q.attach_grad()
+        with autograd.record():
+            out = fn(q, k, v, causal=True)
+        out.backward(nd.ones_like(out))
+        grads.append(q.grad.asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-5)
+
+
+def test_mha_pallas_impl_matches_xla():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(2, 24, 32).astype(np.float32))
+    mha_x = MultiHeadAttention(32, 4, attention_impl="xla")
+    mha_x.initialize(init="xavier")
+    mha_p = MultiHeadAttention(32, 4, attention_impl="pallas",
+                               params=mha_x.collect_params())
+    np.testing.assert_allclose(mha_p(x).asnumpy(), mha_x(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_feature_flag_is_honest():
+    import jax
+
+    from incubator_mxnet_tpu import runtime
+
+    feats = runtime.Features()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    assert feats.is_enabled("PALLAS") == on_tpu
+
+
+def test_flash_causal_cross_attention_alignment():
+    # tq != tk: causal must use bottom-right alignment (tril k=tk-tq)
+    # exactly like the XLA reference — decode-style steps see all history
+    rng = np.random.RandomState(3)
+    q = nd.array(rng.randn(1, 1, 4, 16).astype(np.float32))
+    k = nd.array(rng.randn(1, 1, 8, 16).astype(np.float32))
+    v = nd.array(rng.randn(1, 1, 8, 16).astype(np.float32))
+    out = nd.flash_attention(q, k, v, causal=True).asnumpy()
+    ref = nd.scaled_dot_product_attention(q, k, v, causal=True).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_lengths_matches_masked_xla():
+    rng = np.random.RandomState(4)
+    b, h, t, d = 3, 2, 48, 16
+    q = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    k = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    v = nd.array(rng.randn(b, h, t, d).astype(np.float32))
+    lengths = nd.array(np.array([48, 17, 5], np.float32))
+    out = nd.invoke_op("flash_attention", q, k, v, lengths).asnumpy()
+    mask = (np.arange(t)[None, None, None, :]
+            < np.array([48, 17, 5]).reshape(-1, 1, 1, 1))
+    ref = nd.scaled_dot_product_attention(
+        q, k, v, mask=nd.array(mask.astype(np.float32))).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bert_valid_length_uses_pallas_and_matches_xla():
+    from incubator_mxnet_tpu import models
+
+    rng = np.random.RandomState(5)
+    tok = nd.array(rng.randint(0, 50, (2, 24)).astype(np.int32))
+    vl = nd.array(np.array([24, 9], np.int32))
+    kw = dict(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+              num_heads=2, max_length=32, dropout=0.0, use_pooler=False,
+              use_decoder=False, use_classifier=False)
+    net_x = models.BERTModel(attention_impl="xla", **kw)
+    net_x.initialize(init="xavier")
+    net_p = models.BERTModel(attention_impl="pallas",
+                             params=net_x.collect_params(), **kw)
+    out_x = net_x(tok, None, vl)[0].asnumpy()
+    out_p = net_p(tok, None, vl)[0].asnumpy()
+    np.testing.assert_allclose(out_p, out_x, rtol=1e-4, atol=1e-4)

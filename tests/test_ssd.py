@@ -1,0 +1,104 @@
+"""SSD-300 model + training tests (BASELINE.json config[4];
+reference example/ssd + GluonCV ssd capability)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import amp, autograd, gluon, models
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.models import SSDMultiBoxLoss
+
+
+def _tiny_ssd(num_classes=2):
+    # full architecture, small input: fewer anchors, fast CPU test
+    return models.SSD(num_classes=num_classes, image_size=300)
+
+
+def _synthetic_batch(b, num_classes, rng):
+    x = rng.rand(b, 3, 300, 300).astype(np.float32)
+    # one gt box per image at a random location, padded to 2 slots
+    label = np.full((b, 2, 5), -1.0, np.float32)
+    for i in range(b):
+        cx, cy = rng.uniform(0.3, 0.7, 2)
+        w, h = rng.uniform(0.2, 0.4, 2)
+        label[i, 0] = [rng.randint(num_classes),
+                       cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+    return nd.array(x), nd.array(label)
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_ssd()
+    net.initialize(init="xavier")
+    cls_pred, loc_pred, anchors = net(nd.uniform(shape=(2, 3, 300, 300)))
+    n = anchors.shape[1]
+    assert n == 8732                       # canonical SSD-300 anchor count
+    assert cls_pred.shape == (2, n, 3)
+    assert loc_pred.shape == (2, n * 4)
+    a = anchors.asnumpy()
+    assert np.isfinite(a).all()
+
+
+def test_ssd_end_to_end_target_and_loss():
+    rng = np.random.RandomState(0)
+    net = _tiny_ssd()
+    net.initialize(init="xavier")
+    x, label = _synthetic_batch(2, 2, rng)
+    cls_pred, loc_pred, anchors = net(x)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred.transpose((0, 2, 1)),
+        overlap_threshold=0.5, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5, ignore_label=-1)
+    assert (ct.asnumpy() > 0).sum() >= 2   # every gt claims >= 1 anchor
+    loss = SSDMultiBoxLoss()(cls_pred, loc_pred, ct, bt, bm)
+    l = loss.asnumpy()
+    assert l.shape == (2,) and np.isfinite(l).all() and (l > 0).all()
+
+
+@pytest.mark.slow
+def test_ssd_train_amp_loss_decreases():
+    """SSD trains under AMP (bf16 policy + dynamic loss scaling) with
+    decreasing loss — the config[4] capability proof."""
+    rng = np.random.RandomState(7)
+    net = _tiny_ssd()
+    net.initialize(init="xavier")
+    net.hybridize()
+    amp.init(target_dtype="bfloat16")
+    try:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 1e-3, "momentum": 0.9,
+                                 "multi_precision": True})
+        amp.init_trainer(trainer)
+        loss_fn = SSDMultiBoxLoss()
+        x, label = _synthetic_batch(2, 2, rng)
+        losses = []
+        for step in range(6):
+            with autograd.record():
+                cls_pred, loc_pred, anchors = net(x)
+                bt, bm, ct = nd.contrib.MultiBoxTarget(
+                    anchors, label, cls_pred.transpose((0, 2, 1)),
+                    negative_mining_ratio=3.0, ignore_label=-1)
+                loss = loss_fn(cls_pred, loc_pred, ct, bt, bm)
+                with amp.scale_loss(loss, trainer) as scaled:
+                    autograd.backward(scaled)
+            trainer.step(2)
+            losses.append(float(loss.mean().asnumpy()))
+        assert np.isfinite(losses).all(), losses
+        assert min(losses[1:]) < losses[0] * 0.85, losses
+    finally:
+        amp.deinit()
+
+
+def test_ssd_inference_pipeline():
+    net = _tiny_ssd()
+    net.initialize(init="xavier")
+    x = nd.uniform(shape=(1, 3, 300, 300))
+    cls_pred, loc_pred, anchors = net(x)
+    probs = nd.softmax(cls_pred, axis=-1).transpose((0, 2, 1))
+    det = nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
+                                       nms_topk=100, threshold=0.01)
+    d = det.asnumpy()
+    assert d.shape == (1, 8732, 6)
+    kept = d[d[..., 0] >= 0]
+    # decoded boxes are clipped to the unit square
+    assert (kept[:, 2:] >= -1e-6).all() and (kept[:, 2:] <= 1 + 1e-6).all()
