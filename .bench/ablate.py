@@ -1,0 +1,29 @@
+import jax, time, numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, autograd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+def timed(fn, n=10):
+    fn(); t0=time.perf_counter()
+    for _ in range(n): r = fn()
+    return (time.perf_counter()-t0)/n
+
+for batch in (128, 256):
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init='xavier'); net.cast('bfloat16')
+    net(mx.nd.zeros((2,3,224,224), dtype='bfloat16'))
+    mesh = parallel.make_mesh({'data': -1})
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd', {'learning_rate':0.1,'momentum':0.9}, mesh=mesh)
+    x = jax.device_put(jnp.asarray(np.random.rand(batch,3,224,224), jnp.bfloat16), NamedSharding(mesh, PartitionSpec('data')))
+    y = jax.device_put(jnp.asarray(np.random.randint(0,1000,(batch,)), jnp.float32), NamedSharding(mesh, PartitionSpec('data')))
+    l = tr.step(x,y); float(jax.device_get(l))
+    dt = timed(lambda: float(jax.device_get(tr.step(x,y))))
+    print(f'batch {batch}: train {batch/dt:.0f} img/s ({dt*1e3:.1f}ms)', flush=True)
+    net.hybridize()
+    xn = mx.nd.NDArray(x)
+    with autograd._RecordingStateScope(False, False):
+        net(xn).sum().asnumpy()
+        dtf = timed(lambda: net(xn).sum().asnumpy())
+    print(f'batch {batch}: fwd-only {batch/dtf:.0f} img/s ({dtf*1e3:.1f}ms)', flush=True)
+    del tr, net

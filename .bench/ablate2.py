@@ -1,0 +1,85 @@
+import jax, time, numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, autograd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+def timed_async(launch, sync, n=10):
+    launch(); sync()
+    t0 = time.perf_counter()
+    for _ in range(n): r = launch()
+    sync(r)
+    return (time.perf_counter()-t0)/n
+
+batch = 128
+mesh = parallel.make_mesh({'data': -1})
+sh = NamedSharding(mesh, PartitionSpec('data'))
+x = jax.device_put(jnp.asarray(np.random.rand(batch,3,224,224), jnp.bfloat16), sh)
+y = jax.device_put(jnp.asarray(np.random.randint(0,1000,(batch,)), jnp.float32), sh)
+
+def build(use_global_stats=False):
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init='xavier'); net.cast('bfloat16')
+    if use_global_stats:
+        for blk in net.collect_params():  # mark BN layers
+            pass
+        def setgs(b):
+            from incubator_mxnet_tpu.gluon.nn import BatchNorm
+            if isinstance(b, BatchNorm): b._kwargs_use_global = True; b._use_global_stats = True
+        net.apply(setgs)
+    net(mx.nd.zeros((2,3,224,224), dtype='bfloat16'))
+    return net
+
+# 1. baseline train
+net = build()
+tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd', {'learning_rate':0.1,'momentum':0.9}, mesh=mesh)
+l = tr.step(x,y); float(jax.device_get(l))
+dt = timed_async(lambda: tr.step(x,y), lambda r=None: float(jax.device_get(r if r is not None else l)))
+print(f'train: {batch/dt:.0f} img/s ({dt*1e3:.1f}ms)', flush=True)
+
+# 2. fwd only (jit of pure forward)
+from incubator_mxnet_tpu.gluon.block import _Trace
+from incubator_mxnet_tpu.gluon.parameter import _trace as _ptrace
+from incubator_mxnet_tpu import random as _rnd
+by_name = net._collect_params_with_prefix()
+objs = list(dict.fromkeys(by_name.values()))
+params = {i: jnp.array(tr.params[n]) if n in tr.params else jnp.array(tr.frozen[n])
+          for i, (n, p) in enumerate(zip(by_name, objs))}
+params = {i: v for i, v in params.items()}
+del tr
+from incubator_mxnet_tpu.ndarray import NDArray
+def fwd(params, x):
+    pm = {id(p): NDArray(params[i]) for i, p in enumerate(objs)}
+    t = _Trace(pm); _ptrace.stack.append(t)
+    try:
+        with _rnd.key_provider(jax.random.PRNGKey(0)), autograd._RecordingStateScope(False, False):
+            return jnp.float32(net.forward(NDArray(x))._data.sum())
+    finally:
+        _ptrace.stack.pop()
+fwd_j = jax.jit(fwd)
+float(fwd_j(params, x))
+dtf = timed_async(lambda: fwd_j(params, x), lambda r=None: float(r) if r is not None else None)
+print(f'fwd-only: {batch/dtf:.0f} img/s ({dtf*1e3:.1f}ms)', flush=True)
+
+# 3. fwd+bwd (grad wrt params, no optimizer)
+def loss_fn(params, x, y):
+    pm = {id(p): NDArray(params[i]) for i, p in enumerate(objs)}
+    t = _Trace(pm); _ptrace.stack.append(t)
+    try:
+        with _rnd.key_provider(jax.random.PRNGKey(0)), autograd._RecordingStateScope(False, True):
+            out = net.forward(NDArray(x))
+            ls = gluon.loss.SoftmaxCrossEntropyLoss()(out, NDArray(y))
+            return jnp.mean(ls._data.astype(jnp.float32))
+    finally:
+        _ptrace.stack.pop()
+grad_j = jax.jit(jax.value_and_grad(loss_fn))
+v, g = grad_j(params, x, y); float(v)
+dtg = timed_async(lambda: grad_j(params, x, y)[0], lambda r=None: float(r) if r is not None else None)
+print(f'fwd+bwd: {batch/dtg:.0f} img/s ({dtg*1e3:.1f}ms)', flush=True)
+
+# 4. train with use_global_stats BN (no batch-stat reductions)
+net2 = build(use_global_stats=True)
+tr2 = parallel.SPMDTrainer(net2, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd', {'learning_rate':0.1,'momentum':0.9}, mesh=mesh)
+l = tr2.step(x,y); float(jax.device_get(l))
+dt2 = timed_async(lambda: tr2.step(x,y), lambda r=None: float(jax.device_get(r if r is not None else l)))
+print(f'train-noBNstats: {batch/dt2:.0f} img/s ({dt2*1e3:.1f}ms)', flush=True)

@@ -1,0 +1,28 @@
+import jax, time, numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+jax.config.update('jax_default_matmul_precision', 'default')
+
+def timed_async(launch, sync, n=10):
+    launch(); sync()
+    t0 = time.perf_counter()
+    for _ in range(n): r = launch()
+    sync(r)
+    return (time.perf_counter()-t0)/n
+
+for batch in (128, 256, 512):
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init='xavier'); net.cast('bfloat16')
+    net(mx.nd.zeros((2,3,224,224), dtype='bfloat16'))
+    mesh = parallel.make_mesh({'data': -1})
+    sh = NamedSharding(mesh, PartitionSpec('data'))
+    x = jax.device_put(jnp.asarray(np.random.rand(batch,3,224,224), jnp.bfloat16), sh)
+    y = jax.device_put(jnp.asarray(np.random.randint(0,1000,(batch,)), jnp.float32), sh)
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd', {'learning_rate':0.1,'momentum':0.9}, mesh=mesh)
+    l = tr.step(x,y); float(jax.device_get(l))
+    dt = timed_async(lambda: tr.step(x,y), lambda r=None: float(jax.device_get(r if r is not None else l)))
+    print(f'precision=default batch {batch}: {batch/dt:.0f} img/s ({dt*1e3:.1f}ms)', flush=True)
+    del tr, net, x, y

@@ -132,6 +132,26 @@ config.register(
 config.register(
     "MXTPU_GPU_MEM_POOL_RESERVE", 5, int,
     "Percent of device memory kept free by the allocator facade.")
+config.register(
+    "MXTPU_MATMUL_PRECISION", "auto", str,
+    "Matmul precision for compiled train/hybridize steps: 'auto' (DEFAULT "
+    "precision when the model runs in bf16/fp16 — the fast MXU path; full "
+    "precision otherwise), or an explicit jax precision name "
+    "('default'/'high'/'highest'). Eager f32 ops always use 'highest' "
+    "(reference cuBLAS fp32 parity).")
+
+
+def matmul_precision_for(dtypes) -> str:
+    """Resolve the trace-time matmul precision for a compiled step given
+    the parameter dtypes involved."""
+    val = str(config.get("MXTPU_MATMUL_PRECISION")).lower()
+    if val != "auto":
+        return val
+    low = {"bfloat16", "float16"}
+    names = {getattr(d, "name", str(d)) for d in dtypes}
+    if names and names & low:
+        return "default"
+    return "highest"
 
 
 def is_naive_engine() -> bool:

@@ -347,6 +347,12 @@ class CachedOp:
         block = self._block
         n_params = len(params)
 
+        import jax as _jax
+
+        from ..config import matmul_precision_for
+
+        precision = matmul_precision_for(p.dtype for p in params)
+
         def pure(*flat):
             param_data = flat[:n_params]
             input_data = flat[n_params:n_params + n_inputs]
@@ -358,7 +364,8 @@ class CachedOp:
             _trace.stack.append(trace)
             try:
                 with _random.key_provider(rng), \
-                        autograd._RecordingStateScope(False, training):
+                        autograd._RecordingStateScope(False, training), \
+                        _jax.default_matmul_precision(precision):
                     out = block.forward(*ins)
             finally:
                 _trace.stack.pop()
@@ -536,8 +543,13 @@ class HybridBlock(Block):
             self._cached_op = CachedOp(self, **self._cached_op_args)
         for hook in self._forward_pre_hooks:
             hook(self, args)
+        if args and all(isinstance(a, NDArray) for a in args):
+            # remember the call signature so export() can replay it
+            self._last_input_spec = [(a.shape, str(a.dtype)) for a in args]
+        from ..ndarray.ndarray import _graph_recorders
+
         out = None
-        if (self._active and _trace.stack == []
+        if (self._active and _trace.stack == [] and not _graph_recorders
                 and all(isinstance(a, NDArray) for a in args)):
             try:
                 out = self._cached_op(*args)
@@ -560,11 +572,106 @@ class HybridBlock(Block):
         raise NotImplementedError
 
     def export(self, path: str, epoch: int = 0):
-        """Serialize for deployment (reference ``HybridBlock.export``:
-        symbol-json + params). Here: params + a StableHLO text of the jitted
-        forward when available."""
+        """Serialize for deployment (reference ``HybridBlock.export``):
+        writes ``path-symbol.json`` + ``path-{epoch:04d}.params``, the
+        same two-artifact contract, round-trippable with
+        ``SymbolBlock.imports``.
+
+        The graph is captured by replaying one eager inference forward
+        through the ``invoke`` chokepoint with a GraphRecorder (the
+        TPU-native analog of the reference's trace-into-Symbol), so any
+        net whose forward is built from registered ops exports.
+        """
+        from .. import autograd as _ag
+        from ..ndarray import ndarray as _ndimpl
+        from ..ndarray.ndarray import GraphRecorder, _graph_recorders
+        from ..ops import registry as _registry
+        from ..symbol.symbol import _Node, _name_manager, Symbol
+
+        spec = getattr(self, "_last_input_spec", None)
+        if not spec:
+            raise RuntimeError(
+                "export() needs a recorded input signature; run one "
+                "forward pass first")
+        ins = [_ndimpl.zeros(s, dtype=dt) for s, dt in spec]
+
+        by_name = self._collect_params_with_prefix()
+        id2entry = {}
+        for i, x in enumerate(ins):
+            name = "data" if len(ins) == 1 else f"data{i}"
+            id2entry[id(x)] = (_Node(None, name, {}, []), 0)
+        for pname, p in by_name.items():
+            if p._data is not None:
+                id2entry[id(p.data())] = (_Node(None, pname, {}, []), 0)
+
+        rec = GraphRecorder()
+        _graph_recorders.append(rec)
+        try:
+            with _ag._RecordingStateScope(False, False):
+                out = self.forward(*ins)
+        finally:
+            _graph_recorders.pop()
+
+        def sanitize(v):
+            if v is None:                     # e.g. slice end=None bounds
+                return None
+            if isinstance(v, (bool, int, float, str)):
+                return v
+            if isinstance(v, (tuple, list)):
+                return tuple(sanitize(x) for x in v)
+            try:
+                import numpy as _np
+
+                return _np.dtype(v).name      # dtype-likes -> name string
+            except Exception:
+                raise ValueError(
+                    f"export: op attribute {v!r} is not serializable")
+
+        # invoke-name -> registry-name for NDArray dunder methods whose
+        # label differs from the canonical op (inputs are already in
+        # registry argument order; reverse variants were swapped upstream)
+        aliases = {"add": "elemwise_add", "sub": "elemwise_sub",
+                   "rsub": "elemwise_sub", "mul": "elemwise_mul",
+                   "div": "elemwise_div", "rdiv": "elemwise_div",
+                   "rmod": "broadcast_mod", "pow": "broadcast_power",
+                   "rpow": "broadcast_power", "neg": "negative",
+                   "eq": "broadcast_equal", "ne": "broadcast_not_equal",
+                   "gt": "broadcast_greater",
+                   "ge": "broadcast_greater_equal",
+                   "lt": "broadcast_lesser", "le": "broadcast_lesser_equal",
+                   "sdpa": "scaled_dot_product_attention"}
+        for opname, kwargs, in_list, out_list in rec.entries:
+            opdef = _registry.get(aliases.get(opname, opname))
+            if opdef is None:
+                raise ValueError(
+                    f"export: op {opname!r} is not a registered op; this "
+                    "forward cannot be exported to symbol json")
+            attrs = {k: sanitize(v) for k, v in kwargs.items()
+                     if k not in ("rng", "training") and v is not None}
+            parents = []
+            for x in in_list:
+                if id(x) not in id2entry:
+                    raise ValueError(
+                        f"export: op {opname!r} consumes an array that is "
+                        "neither an input, a parameter, nor a recorded op "
+                        "output (constant captured inside forward)")
+                parents.append(id2entry[id(x)])
+            node = _Node(opdef.name, _name_manager.get(opdef.name.lower()),
+                         attrs, parents, num_outputs=len(out_list))
+            for j, o in enumerate(out_list):
+                id2entry[id(o)] = (node, j)
+
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        heads = []
+        for o in outs:
+            if id(o) not in id2entry:
+                raise ValueError("export: an output was not produced by a "
+                                 "recorded op")
+            heads.append(id2entry[id(o)])
+        sym = Symbol(heads)
+        sym.save(f"{path}-symbol.json")
         self.save_parameters(f"{path}-{epoch:04d}.params")
-        return f"{path}-{epoch:04d}.params"
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 
 
 class SymbolBlock(HybridBlock):

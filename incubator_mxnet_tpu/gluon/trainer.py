@@ -106,11 +106,15 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None or not self._distributed:
             return
+        keys, grads = [], []
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None \
                     and p._data._grad is not None:
-                g = p.grad()
-                self._kvstore.pushpull(i, g, out=g)
+                keys.append(i)
+                grads.append(p.grad())
+        # one batched call: KVStoreDist fuses ALL gradients into a single
+        # compiled collective instead of per-tensor host round-trips
+        self._kvstore.pushpull_list(keys, grads, grads)
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False):
         if not self._kv_initialized:

@@ -200,6 +200,13 @@ class KVStore:
                 else:
                     o._set_data(jnp.asarray(agg._data, o.dtype))
 
+    def pushpull_list(self, keys, values, outs, priority: int = 0) -> None:
+        """Fused allreduce over MANY keys at once (the gradient-batch path;
+        reference grouped NCCL calls in kvstore_nccl.cc). Base class:
+        per-key loop; KVStoreDist overrides with one compiled collective."""
+        for k, v, o in zip(keys, values, outs):
+            self.pushpull(k, v, out=o, priority=priority)
+
     def broadcast(self, key, value, out, priority: int = 0) -> None:
         self.init(key, value)
         self.pull(key, out, priority)
@@ -270,10 +277,49 @@ class KVStoreDist(KVStore):
         return self._size
 
     def _reduce(self, vlist):
+        from .ndarray.sparse import RowSparseNDArray
+
         local = super()._reduce(vlist)
         if self._size > 1:
             from .parallel import allreduce_across_processes
 
+            if isinstance(local, RowSparseNDArray):
+                # cross-process sparse push: indices differ per worker, so
+                # the collective runs dense, then the result goes BACK to
+                # row_sparse (union of touched rows) — push() keeps its
+                # touched-rows-only overwrite semantics (reference
+                # server-side row_sparse aggregation)
+                dense = allreduce_across_processes(
+                    local.tostype("default")._data)
+                return NDArray(dense, ctx=local.ctx).tostype("row_sparse")
             return NDArray(allreduce_across_processes(local._data),
                            ctx=local.ctx)
         return local
+
+    def pushpull_list(self, keys, values, outs, priority: int = 0) -> None:
+        """All keys in ONE compiled cross-process collective (the 8->256
+        chip scaling path — one XLA computation, no per-tensor host
+        round-trips)."""
+        from .ndarray.sparse import RowSparseNDArray
+
+        if self._size <= 1 or self._updater is not None:
+            return super().pushpull_list(keys, values, outs, priority)
+        aggs = []
+        for v in values:
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            agg = KVStore._reduce(self, vlist)     # local (intra-process)
+            if isinstance(agg, RowSparseNDArray):
+                agg = agg.tostype("default")
+            aggs.append(agg)
+        from .parallel.collectives import allreduce_arrays
+
+        summed = allreduce_arrays([a._data for a in aggs])
+        for o, s in zip(outs, summed):
+            for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(oo, RowSparseNDArray):
+                    cast = NDArray(jnp.asarray(s, oo.dtype)
+                                   ).tostype("row_sparse")
+                    oo._rdata = cast._rdata
+                    oo._indices = cast._indices
+                else:
+                    oo._set_data(jnp.asarray(s, oo.dtype))

@@ -115,6 +115,23 @@ def _maybe_capture(in_nd):
 
 
 # ---------------------------------------------------------------------------
+# Graph recording (HybridBlock.export: one eager forward -> Symbol DAG)
+# ---------------------------------------------------------------------------
+class GraphRecorder:
+    """Records the invoke() stream of one eager forward — each entry is
+    (op_name, kwargs, input NDArrays, output NDArrays) — so export() can
+    rebuild the computation as a Symbol graph (the deploy json of the
+    reference's trace-into-CachedOp path, built from the same imperative
+    chokepoint)."""
+
+    def __init__(self):
+        self.entries: List[Tuple[str, dict, list, list]] = []
+
+
+_graph_recorders: List[GraphRecorder] = []
+
+
+# ---------------------------------------------------------------------------
 # Imperative dispatch (the Imperative::Invoke analog, SURVEY.md §3.1)
 # ---------------------------------------------------------------------------
 def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
@@ -159,6 +176,9 @@ def invoke(fn, inputs: Sequence["NDArray"], kwargs: Optional[dict] = None,
     if recording:
         autograd.record_op(vjp_fn, in_nd, outs, name=name, pure_fn=pure,
                            pure_tuple=not single)
+    if _graph_recorders and name:
+        _graph_recorders[-1].entries.append(
+            (name, dict(kwargs), list(in_nd), list(outs)))
     if is_naive_engine():
         for o in outs:
             o._data.block_until_ready()
@@ -184,6 +204,21 @@ def as_nd(x, ctx: Optional[Context] = None, dtype=None) -> "NDArray":
 # ---------------------------------------------------------------------------
 # NDArray
 # ---------------------------------------------------------------------------
+# (method name, reversed) -> registered scalar op (reference _plus_scalar
+# family): attr-scalars keep the array dtype and make the node exportable
+_SCALAR_OPS = {
+    ("add", False): "_plus_scalar", ("add", True): "_plus_scalar",
+    ("sub", False): "_minus_scalar", ("rsub", True): "_rminus_scalar",
+    ("mul", False): "_mul_scalar", ("mul", True): "_mul_scalar",
+    ("div", False): "_div_scalar", ("rdiv", True): "_rdiv_scalar",
+    ("mod", False): "_mod_scalar", ("rmod", True): "_rmod_scalar",
+    ("pow", False): "_power_scalar", ("rpow", True): "_rpower_scalar",
+    ("eq", False): "_equal_scalar", ("ne", False): "_not_equal_scalar",
+    ("gt", False): "_greater_scalar", ("ge", False): "_greater_equal_scalar",
+    ("lt", False): "_lesser_scalar", ("le", False): "_lesser_equal_scalar",
+}
+
+
 class NDArray:
     __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
                  "_grad_req", "_grad_fresh", "__weakref__")
@@ -360,50 +395,55 @@ class NDArray:
         if 0 in shape:
             shape = tuple(self.shape[i] if s == 0 else s
                           for i, s in enumerate(shape))
-        return invoke(lambda x: jnp.reshape(x, shape), [self], name="reshape")
+        # registry-fn dispatch with explicit attrs: graph-exportable
+        return invoke(get_op("reshape").fn, [self], {"shape": shape},
+                      name="reshape")
 
     def reshape_like(self, other: "NDArray") -> "NDArray":
         return self.reshape(other.shape)
 
     def transpose(self, axes=None) -> "NDArray":
-        return invoke(lambda x: jnp.transpose(x, axes), [self], name="transpose")
+        kw = {} if axes is None else {"axes": tuple(axes)}
+        return invoke(get_op("transpose").fn, [self], kw, name="transpose")
 
     def swapaxes(self, a: int, b: int) -> "NDArray":
-        return invoke(lambda x: jnp.swapaxes(x, a, b), [self], name="swapaxes")
+        return invoke(get_op("swapaxes_op").fn, [self],
+                      {"dim1": a, "dim2": b}, name="swapaxes_op")
 
     def expand_dims(self, axis: int) -> "NDArray":
-        return invoke(lambda x: jnp.expand_dims(x, axis), [self],
+        return invoke(get_op("expand_dims").fn, [self], {"axis": axis},
                       name="expand_dims")
 
     def squeeze(self, axis=None) -> "NDArray":
-        return invoke(lambda x: jnp.squeeze(x, axis), [self], name="squeeze")
+        kw = {} if axis is None else {"axis": axis}
+        return invoke(get_op("squeeze").fn, [self], kw, name="squeeze")
 
     def flatten(self) -> "NDArray":
         n = self.shape[0] if self.ndim > 0 else 1
         return self.reshape(n, -1)
 
     def broadcast_to(self, shape) -> "NDArray":
-        return invoke(lambda x: jnp.broadcast_to(x, tuple(shape)), [self],
-                      name="broadcast_to")
+        return invoke(get_op("broadcast_to").fn, [self],
+                      {"shape": tuple(shape)}, name="broadcast_to")
 
     def broadcast_like(self, other: "NDArray") -> "NDArray":
         return self.broadcast_to(other.shape)
 
     def slice(self, begin, end, step=None) -> "NDArray":
-        idx = tuple(
-            _builtin_slice(b, e, s) for b, e, s in zip(
-                begin, end, step or (None,) * len(begin)))
-        return self[idx]
+        kw = {"begin": tuple(begin), "end": tuple(end)}
+        if step is not None:
+            kw["step"] = tuple(step)
+        return invoke(get_op("slice").fn, [self], kw, name="slice")
 
-    def slice_axis(self, axis: int, begin: int, end: Optional[int]) -> "NDArray":
-        idx = [slice(None)] * self.ndim
-        idx[axis] = slice(begin, end)
-        return self[tuple(idx)]
+    def slice_axis(self, axis: int, begin: int,
+                   end: Optional[int]) -> "NDArray":
+        return invoke(get_op("slice_axis").fn, [self],
+                      {"axis": axis, "begin": begin, "end": end},
+                      name="slice_axis")
 
     def take(self, indices, axis=0, mode="clip") -> "NDArray":
-        return invoke(lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis,
-                                            mode=mode),
-                      [self, as_nd(indices)], name="take")
+        return invoke(get_op("take").fn, [self, as_nd(indices)],
+                      {"axis": axis, "mode": mode}, name="take")
 
     # -- indexing ----------------------------------------------------------
     def __getitem__(self, key) -> "NDArray":
@@ -423,9 +463,16 @@ class NDArray:
     def _binop(self, other, fn, name, reverse=False):
         if isinstance(other, (int, float, bool)) and not isinstance(
                 other, NDArray):
-            # scalar operand: fold it into the op so jnp's weak-type
-            # promotion preserves the array dtype (reference scalar-op
-            # semantics — bf16 * 2.0 stays bf16, not float32)
+            # scalar operand: dispatch through the _*_scalar op family so
+            # (a) jnp's weak-type promotion preserves the array dtype
+            # (bf16 * 2.0 stays bf16, not float32 — reference scalar-op
+            # semantics) and (b) the node is graph-exportable
+            scalar_op = _SCALAR_OPS.get((name, bool(reverse)))
+            if scalar_op is not None:
+                opdef = get_op(scalar_op)
+                return invoke(opdef.fn, [self], {"scalar": other},
+                              name=opdef.name,
+                              differentiable=opdef.differentiable)
             s = other
             if reverse:
                 return invoke(lambda a: fn(s, a), [self], name=name)
@@ -491,6 +538,13 @@ class NDArray:
 
     # comparisons (not differentiable)
     def _cmp(self, other, fn, name):
+        if isinstance(other, (int, float, bool)) and not isinstance(
+                other, NDArray):
+            scalar_op = _SCALAR_OPS.get((name, False))
+            if scalar_op is not None:
+                opdef = get_op(scalar_op)
+                return invoke(opdef.fn, [self], {"scalar": other},
+                              name=opdef.name, differentiable=False)
         o = as_nd(other, ctx=self._ctx)
         return invoke(fn, [self, o], name=name, differentiable=False)
 
@@ -515,38 +569,39 @@ class NDArray:
     __hash__ = object.__hash__
 
     # -- reductions (method forms) -----------------------------------------
+    def _reduce_method(self, name, axis, keepdims, **extra):
+        # registry dispatch with explicit attrs: graph-exportable
+        kw = dict(extra)
+        if axis is not None:
+            kw["axis"] = axis
+        kw["keepdims"] = keepdims
+        opdef = get_op(name)
+        return invoke(opdef.fn, [self], kw, name=name,
+                      differentiable=opdef.differentiable)
+
     def sum(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.sum(x, axis=axis, keepdims=keepdims),
-                      [self], name="sum")
+        return self._reduce_method("sum", axis, keepdims)
 
     def mean(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.mean(x, axis=axis, keepdims=keepdims),
-                      [self], name="mean")
+        return self._reduce_method("mean", axis, keepdims)
 
     def max(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.max(x, axis=axis, keepdims=keepdims),
-                      [self], name="max")
+        return self._reduce_method("max", axis, keepdims)
 
     def min(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
-                      [self], name="min")
+        return self._reduce_method("min", axis, keepdims)
 
     def prod(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims),
-                      [self], name="prod")
+        return self._reduce_method("prod", axis, keepdims)
 
     def argmax(self, axis=None):
-        return invoke(lambda x: jnp.argmax(x, axis=axis).astype(jnp.float32),
-                      [self], name="argmax", differentiable=False)
+        return self._reduce_method("argmax", axis, False)
 
     def argmin(self, axis=None):
-        return invoke(lambda x: jnp.argmin(x, axis=axis).astype(jnp.float32),
-                      [self], name="argmin", differentiable=False)
+        return self._reduce_method("argmin", axis, False)
 
     def norm(self, ord=2, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.linalg.norm(
-            x if axis is not None or x.ndim <= 2 else x.reshape(-1),
-            ord=ord, axis=axis, keepdims=keepdims), [self], name="norm")
+        return self._reduce_method("norm", axis, keepdims, ord=ord)
 
     def abs(self):
         return invoke(jnp.abs, [self], name="abs")

@@ -507,3 +507,97 @@ def broadcast_axis(x, axis=(), size=()):
 def broadcast_to(x, shape=None):
     shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
     return jnp.broadcast_to(x, shape)
+
+
+# -- scalar arithmetic ops (reference _plus_scalar/_mul_scalar/... family,
+#    src/operator/tensor/elemwise_binary_scalar_op_basic.cc). The scalar is
+#    an attr, not an array, so jnp weak-type promotion preserves the array
+#    dtype AND graph export can serialize the node.
+@register("_plus_scalar", aliases=("plus_scalar",))
+def _plus_scalar(x, scalar=0.0):
+    return x + scalar
+
+
+@register("_minus_scalar", aliases=("minus_scalar",))
+def _minus_scalar(x, scalar=0.0):
+    return x - scalar
+
+
+@register("_rminus_scalar", aliases=("rminus_scalar",))
+def _rminus_scalar(x, scalar=0.0):
+    return scalar - x
+
+
+@register("_mul_scalar", aliases=("mul_scalar",))
+def _mul_scalar(x, scalar=1.0):
+    return x * scalar
+
+
+@register("_div_scalar", aliases=("div_scalar",))
+def _div_scalar(x, scalar=1.0):
+    return x / scalar
+
+
+@register("_rdiv_scalar", aliases=("rdiv_scalar",))
+def _rdiv_scalar(x, scalar=1.0):
+    return scalar / x
+
+
+@register("_power_scalar", aliases=("power_scalar",))
+def _power_scalar(x, scalar=1.0):
+    return x ** scalar
+
+
+@register("_rpower_scalar", aliases=("rpower_scalar",))
+def _rpower_scalar(x, scalar=1.0):
+    return scalar ** x
+
+
+@register("_mod_scalar", aliases=("mod_scalar",))
+def _mod_scalar(x, scalar=1.0):
+    return x % scalar
+
+
+@register("_rmod_scalar", aliases=("rmod_scalar",))
+def _rmod_scalar(x, scalar=1.0):
+    return scalar % x
+
+
+@register("_maximum_scalar", aliases=("maximum_scalar",))
+def _maximum_scalar(x, scalar=0.0):
+    return jnp.maximum(x, scalar)
+
+
+@register("_minimum_scalar", aliases=("minimum_scalar",))
+def _minimum_scalar(x, scalar=0.0):
+    return jnp.minimum(x, scalar)
+
+
+@register("_equal_scalar", differentiable=False)
+def _equal_scalar(x, scalar=0.0):
+    return (x == scalar).astype(x.dtype)
+
+
+@register("_not_equal_scalar", differentiable=False)
+def _not_equal_scalar(x, scalar=0.0):
+    return (x != scalar).astype(x.dtype)
+
+
+@register("_greater_scalar", differentiable=False)
+def _greater_scalar(x, scalar=0.0):
+    return (x > scalar).astype(x.dtype)
+
+
+@register("_greater_equal_scalar", differentiable=False)
+def _greater_equal_scalar(x, scalar=0.0):
+    return (x >= scalar).astype(x.dtype)
+
+
+@register("_lesser_scalar", differentiable=False)
+def _lesser_scalar(x, scalar=0.0):
+    return (x < scalar).astype(x.dtype)
+
+
+@register("_lesser_equal_scalar", differentiable=False)
+def _lesser_equal_scalar(x, scalar=0.0):
+    return (x <= scalar).astype(x.dtype)

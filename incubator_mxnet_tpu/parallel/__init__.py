@@ -15,6 +15,7 @@ Axes convention (scaling-book style): ``data`` (DP), ``model`` (TP),
 
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, current_mesh, make_mesh,
                    mesh_scope)
-from .collectives import (allreduce_across_processes, init_distributed,
-                          pmean, psum)
+from .collectives import (allreduce_across_processes, allreduce_arrays,
+                          init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
+from .checkpoint import restore_sharded, save_sharded

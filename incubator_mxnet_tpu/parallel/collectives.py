@@ -61,7 +61,61 @@ def allreduce_across_processes(x: jax.Array) -> jax.Array:
     (kvstore dist_sync push aggregation). Single-process: identity."""
     if jax.process_count() == 1:
         return x
-    from jax.experimental import multihost_utils
+    return allreduce_arrays([x])[0]
 
-    gathered = multihost_utils.process_allgather(x)
-    return jnp.sum(gathered, axis=0)
+
+_proc_mesh = None
+_allreduce_cache = {}
+
+
+def _process_mesh():
+    """A 1-device-per-process global mesh (the DCN allreduce domain)."""
+    global _proc_mesh
+    if _proc_mesh is None:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[p] for p in sorted(per_proc)]
+        _proc_mesh = Mesh(np.array(devs), ("proc",))
+    return _proc_mesh
+
+
+def allreduce_arrays(xs):
+    """Sum a LIST of identically-shaped-per-process arrays across all
+    processes in ONE compiled XLA computation — the scaling path for
+    multi-host gradients (replaces per-tensor host-side process_allgather;
+    reference kvstore_dist push aggregation -> XLA collective over
+    ICI/DCN). Returns process-local arrays."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if jax.process_count() == 1:
+        return list(xs)
+    mesh = _process_mesh()
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    local_dev = mesh.devices.flat[rank]
+    shard_sharding = NamedSharding(mesh, PartitionSpec("proc"))
+
+    gxs = []
+    for x in xs:
+        local = jax.device_put(jnp.asarray(x)[None], local_dev)
+        gxs.append(jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(x.shape), shard_sharding, [local]))
+
+    key = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+    fn = _allreduce_cache.get(key)
+    if fn is None:
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        def _sum_all(arrs):
+            return [jnp.sum(a, axis=0) for a in arrs]
+
+        fn = jax.jit(_sum_all,
+                     out_shardings=[replicated for _ in xs])
+        _allreduce_cache[key] = fn
+    outs = fn(gxs)
+    # each output is replicated on the process mesh; hand back the local copy
+    return [o.addressable_data(0) for o in outs]

@@ -170,13 +170,22 @@ class SPMDTrainer:
                    if i in id2name}
             return loss_val, aux
 
+        from ..config import matmul_precision_for
+
+        precision = matmul_precision_for(
+            p.dtype for p in self.params.values())
+
         def step(train_p, frozen_p, opt_state, rng, data_arrays,
                  label_arrays):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_p, frozen_p, rng, data_arrays,
-                                       label_arrays)
-            updates, opt_state = tx.update(grads, opt_state, train_p)
-            train_p = optax.apply_updates(train_p, updates)
+            # bf16 models trace at DEFAULT matmul precision (native MXU
+            # bf16 passes); f32 models keep full precision — overriding
+            # the package-global 'highest' for the compiled fast path
+            with jax.default_matmul_precision(precision):
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_p, frozen_p, rng,
+                                           data_arrays, label_arrays)
+                updates, opt_state = tx.update(grads, opt_state, train_p)
+                train_p = optax.apply_updates(train_p, updates)
             for n, v in aux.items():
                 if n in frozen_p:
                     frozen_p = {**frozen_p, n: v}

@@ -1,26 +1,41 @@
 """Test configuration.
 
 Reference test strategy (SURVEY.md §4): one suite, many contexts; numpy as
-oracle; seed discipline via MXNET_TEST_SEED. Multi-chip tests run on a
-virtual 8-device CPU mesh (``xla_force_host_platform_device_count``), the
-analog of the reference's multi-process-on-one-box launcher tests.
+oracle; seed discipline via MXNET_TEST_SEED.
+
+Two platforms (the reference's cpu/gpu re-import trick, context-parametrized
+at the process level):
+
+- default: 8-virtual-device CPU mesh (``xla_force_host_platform_device
+  _count``) — fast, and required for the mesh/parallel tests; the analog of
+  the reference's multi-process-on-one-box launcher tests.
+- ``MXTPU_TEST_PLATFORM=tpu``: run the same suites on the real TPU chip
+  (single device; multi-device tests auto-skip). bf16-aware tolerances come
+  from test_utils.default_rtol_atol. Example:
+
+      MXTPU_TEST_PLATFORM=tpu python -m pytest tests/test_operator.py \
+          tests/test_ndarray.py tests/test_gluon.py -q
 """
 
 import os
 
-# Must run before jax is imported anywhere.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+_PLATFORM = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+
+if _PLATFORM == "cpu":
+    # Must run before jax is imported anywhere.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-# The environment's sitecustomize force-registers the axon TPU plugin and
-# overrides JAX_PLATFORMS; re-override so the test suite runs on the
-# 8-virtual-device CPU backend (fast, and required for mesh tests).
-jax.config.update("jax_platforms", "cpu")
+if _PLATFORM == "cpu":
+    # The environment's sitecustomize force-registers the axon TPU plugin
+    # and overrides JAX_PLATFORMS; re-override so the test suite runs on
+    # the 8-virtual-device CPU backend (fast, and required for mesh tests).
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
@@ -28,6 +43,19 @@ import pytest
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running training tests")
+
+
+def pytest_collection_modifyitems(config, items):
+    if len(jax.devices()) > 1:
+        return
+    # single-chip run (MXTPU_TEST_PLATFORM=tpu): the multi-device SPMD /
+    # distributed suites need the virtual CPU mesh
+    multi_dev = ("test_parallel", "test_distributed", "test_bert_seqparallel")
+    skip = pytest.mark.skip(reason="needs a multi-device mesh "
+                                   "(run on the CPU test platform)")
+    for item in items:
+        if any(m in item.nodeid for m in multi_dev):
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,165 @@
+"""HybridBlock.export -> symbol-json + params -> SymbolBlock.imports
+round trip (reference deploy contract, SURVEY.md §5 checkpoint row &
+§2.2 Gluon core export)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.block import SymbolBlock
+
+
+def test_export_dense_bn_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(3, 8))
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "model"))
+    assert sj.endswith("-symbol.json") and pp.endswith("-0000.params")
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_conv_net_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2, 2), nn.Dense(5))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(2, 3, 8, 8))
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "conv"), epoch=7)
+    assert pp.endswith("-0007.params")
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_bn_aux_states_preserved(tmp_path):
+    """Trained running stats must survive the round trip (the aux case)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm())
+    net.initialize(init="xavier")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.uniform(shape=(16, 4))
+    for _ in range(3):                       # move the running stats
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(16)
+    y0 = net(x)                              # inference w/ updated stats
+    sj, pp = net.export(str(tmp_path / "bn"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert blk._sym_aux_names                # moving stats imported as aux
+
+
+def test_export_scalar_math_and_resnet_slice(tmp_path):
+    class Scaled(nn.HybridSequential):
+        def forward(self, x):
+            h = super().forward(x)
+            return h * 0.5 + 1.0 - (2.0 / (h + 3.0))
+
+    net = Scaled()
+    net.add(nn.Dense(6, activation="tanh"))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(2, 3))
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "scalar"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_model_zoo_resnet18(tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(1, 3, 32, 32))
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "r18"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_export_without_forward_raises(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    with pytest.raises(RuntimeError, match="forward"):
+        net.export(str(tmp_path / "x"))
+
+
+def test_scalar_ops_dtype_and_grad():
+    # the _*_scalar family behind the exportable scalar math
+    x = mx.nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = 2.0 * x + 1.0 - x / 4.0
+    y.backward(mx.nd.ones_like(y))
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.75] * 3, rtol=1e-6)
+    xb = mx.nd.zeros((2,), dtype="bfloat16")
+    assert (xb * 2.0 + 1.0).dtype == xb.dtype
+    np.testing.assert_allclose((1.0 - x).asnumpy(), [0, 3, -2])
+    np.testing.assert_allclose((6.0 / x).asnumpy(), [6, -3, 2])
+    np.testing.assert_allclose((x > 1.0).asnumpy(), [0, 0, 1])
+
+
+def test_export_hybridized_net_roundtrip(tmp_path):
+    """The canonical reference flow: hybridize(); forward; export()."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(12, activation="relu"), nn.BatchNorm(), nn.Dense(3))
+    net.initialize(init="xavier")
+    net.hybridize()
+    x = mx.nd.uniform(shape=(4, 6))
+    net(x)                                   # warm the CachedOp
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "hyb"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_reduction_attrs_preserved(tmp_path):
+    class Reduce(nn.HybridSequential):
+        def forward(self, x):
+            h = super().forward(x)
+            return h.mean(axis=1, keepdims=True) + h.sum(axis=-1,
+                                                         keepdims=True)
+
+    net = Reduce()
+    net.add(nn.Dense(6, in_units=4))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(3, 4))
+    y0 = net(x)
+    assert y0.shape == (3, 1)
+    sj, pp = net.export(str(tmp_path / "red"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_slice_none_bounds_preserved(tmp_path):
+    class Sliced(nn.HybridSequential):
+        def forward(self, x):
+            h = super().forward(x)
+            return h.slice(begin=(0, 1), end=(None, None))
+
+    net = Sliced()
+    net.add(nn.Dense(5, in_units=4))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(3, 4))
+    y0 = net(x)
+    assert y0.shape == (3, 4)
+    sj, pp = net.export(str(tmp_path / "sl"))
+    blk = SymbolBlock.imports(sj, "data", pp)
+    np.testing.assert_allclose(blk(x).asnumpy(), y0.asnumpy(),
+                               rtol=1e-5, atol=1e-6)

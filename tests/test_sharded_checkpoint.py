@@ -1,0 +1,108 @@
+"""Sharded checkpoint of mesh-partitioned training state
+(SURVEY.md §5 checkpoint row: 'per-host sharded checkpoint of a global
+mesh array is the new hard part') + MXTPU001 format-stability pin."""
+
+import os
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _trainer(mesh, seed=0):
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    parallel.shard_params(net, {
+        r"0\.weight": P("model", None),
+        r"2\.weight": P(None, "model"),
+    })
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh, donate=False)
+    return net, tr
+
+
+def _batch(rng):
+    return (rng.rand(16, 8).astype(np.float32),
+            rng.randint(0, 4, (16,)).astype(np.float32))
+
+
+def test_sharded_save_restore_bitwise_equal_step(tmp_path):
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+
+    net, tr = _trainer(mesh)
+    tr.step(x, y)                                  # momentum state nonzero
+    prefix = str(tmp_path / "ckpt")
+    parallel.save_sharded(prefix, tr)
+    assert os.path.exists(prefix + ".manifest.json")
+    assert os.path.exists(prefix + ".shards-0.npz")
+
+    # fresh trainer with different init; restore must fully overwrite
+    net2, tr2 = _trainer(mesh, seed=123)
+    parallel.restore_sharded(prefix, tr2)
+
+    for n in tr.params:
+        np.testing.assert_array_equal(np.asarray(tr.params[n]),
+                                      np.asarray(tr2.params[n]))
+        # shardings preserved
+        assert tr2.params[n].sharding.spec == tr.params[n].sharding.spec
+
+    # one more step on each must produce bitwise-identical params
+    x2, y2 = _batch(np.random.RandomState(7))
+    l1 = float(tr.step(x2, y2))
+    l2 = float(tr2.step(x2, y2))
+    assert l1 == l2
+    for n in tr.params:
+        np.testing.assert_array_equal(np.asarray(tr.params[n]),
+                                      np.asarray(tr2.params[n]))
+
+
+def test_sharded_checkpoint_rejects_bad_magic(tmp_path):
+    import json
+
+    prefix = str(tmp_path / "bad")
+    with open(prefix + ".manifest.json", "w") as f:
+        json.dump({"magic": "nope", "tensors": {}}, f)
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    _, tr = _trainer(mesh)
+    with pytest.raises(ValueError, match="MXTPU-SHARD-1"):
+        parallel.restore_sharded(prefix, tr)
+
+
+def test_tp_shard_files_contain_only_local_rows(tmp_path):
+    """The written shard of a TP-sharded weight is the shard, not the
+    whole tensor (per-host sharded write, not a gather)."""
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    _, tr = _trainer(mesh)
+    prefix = str(tmp_path / "tp")
+    parallel.save_sharded(prefix, tr)
+    z = np.load(prefix + ".shards-0.npz")
+    w_keys = [k for k in z.files if k.startswith("param/0.weight::")]
+    assert len(w_keys) == 2                    # two model-axis shards
+    assert z[w_keys[0]].shape == (8, 8)        # (16/2, 8) each
+
+
+def test_mxtpu001_format_backward_compat():
+    """Pinned artifact: a .params file written by the round-2 MXTPU001
+    writer must keep loading bit-exactly (reference
+    model_backwards_compat nightly)."""
+    here = os.path.join(os.path.dirname(__file__), "compat",
+                        "pinned_mxtpu001.params")
+    loaded = mx.nd.load(here)
+    assert sorted(loaded) == ["bias", "weight"]
+    np.testing.assert_allclose(
+        loaded["weight"].asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(2, 3) / 7.0, rtol=0, atol=0)
+    np.testing.assert_allclose(loaded["bias"].asnumpy(),
+                               np.array([-1.5, 2.25], np.float32),
+                               rtol=0, atol=0)
