@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""opperf — per-operator performance harness over the whole registry
+(reference benchmark/opperf/opperf.py).
+
+Sweeps ``mx.nd`` ops from ``ops.registry.list_ops()``: each op gets
+synthetic inputs from a category-based argspec (tensor/nn/linalg/...),
+runs forward (and backward where differentiable) under async timing, and
+prints a table sorted by time. Ops without an argspec are reported as
+skipped — coverage of the table IS the harness's coverage metric.
+
+    python benchmark/opperf.py                 # all covered ops
+    python benchmark/opperf.py --ops relu,Convolution --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# argspecs: op -> (list of array shapes, kwargs). 'B' in a shape is the
+# sweep batch. Categories keep this table small.
+# ---------------------------------------------------------------------------
+_UNARY_1D = dict.fromkeys("""
+abs sign rint ceil floor trunc fix square sqrt rsqrt cbrt rcbrt exp log
+log10 log2 log1p expm1 reciprocal negative sin cos tan arcsin arccos arctan
+sinh cosh tanh arcsinh arccosh arctanh erf erfinv gamma gammaln digamma
+relu sigmoid softsign softrelu gelu silu mish hard_sigmoid log_sigmoid erfc
+degrees radians round logical_not isnan isinf isfinite zeros_like ones_like
+softmax log_softmax sort topk argsort cumsum logsumexp smooth_l1
+""".split(), ([("B", 1024)], {}))
+
+_REDUCE = dict.fromkeys(
+    "sum mean prod max min argmax argmin norm nansum nanprod".split(),
+    ([("B", 1024)], {"axis": 1}))
+
+_BINARY = dict.fromkeys("""
+elemwise_add elemwise_sub elemwise_mul elemwise_div broadcast_power
+broadcast_maximum broadcast_minimum broadcast_mod broadcast_hypot
+broadcast_equal broadcast_not_equal broadcast_greater
+broadcast_greater_equal broadcast_lesser broadcast_lesser_equal
+broadcast_logical_and broadcast_logical_or broadcast_logical_xor
+""".split(), ([("B", 1024), ("B", 1024)], {}))
+
+_SCALAR = dict.fromkeys("""
+_plus_scalar _minus_scalar _rminus_scalar _mul_scalar _div_scalar
+_rdiv_scalar _power_scalar _rpower_scalar _mod_scalar _rmod_scalar
+_maximum_scalar _minimum_scalar _equal_scalar _not_equal_scalar
+_greater_scalar _greater_equal_scalar _lesser_scalar _lesser_equal_scalar
+""".split(), ([("B", 1024)], {"scalar": 2.0}))
+
+_MATMUL = {
+    "dot": ([(512, 512), (512, 512)], {}),
+    "matmul": ([("B", 256, 256), ("B", 256, 256)], {}),
+    "batch_dot": ([("B", 128, 128), ("B", 128, 128)], {}),
+    "linalg_gemm2": ([("B", 128, 128), ("B", 128, 128)], {}),
+    "linalg_syrk": ([("B", 128, 128)], {}),
+    "linalg_potrf": ("spd", {}),
+    "linalg_potri": ("tri", {}),
+    "linalg_trmm": ("tri_b", {}),
+    "linalg_trsm": ("tri_b", {}),
+    "linalg_sumlogdiag": ("spd", {}),
+    "linalg_det": ("spd", {}),
+    "linalg_slogdet": ("spd", {}),
+    "linalg_inverse": ("spd", {}),
+    "linalg_syevd": ("spd", {}),
+    "linalg_gelqf": ([(64, 128)], {}),
+    "linalg_extractdiag": ([("B", 64, 64)], {}),
+}
+
+_NN = {
+    "FullyConnected": ([("B", 512), (256, 512), (256,)], {}),
+    "Convolution": ([("B", 32, 28, 28), (64, 32, 3, 3), (64,)],
+                    {"kernel": (3, 3), "pad": (1, 1), "num_filter": 64}),
+    "Deconvolution": ([("B", 32, 14, 14), (32, 16, 2, 2), (16,)],
+                      {"kernel": (2, 2), "stride": (2, 2),
+                       "num_filter": 16}),
+    "Pooling": ([("B", 32, 28, 28)], {"kernel": (2, 2), "stride": (2, 2)}),
+    "BatchNorm": ([("B", 32, 14, 14), (32,), (32,), (32,), (32,)], {}),
+    "LayerNorm": ([("B", 512), (512,), (512,)], {}),
+    "RMSNorm": ([("B", 512), (512,)], {}),
+    "Activation": ([("B", 1024)], {"act_type": "relu"}),
+    "LeakyReLU": ([("B", 1024)], {"act_type": "leaky"}),
+    "Embedding": ("embedding", {}),
+    "Dropout": ([("B", 1024)], {"p": 0.5, "training": True}),
+    "scaled_dot_product_attention":
+        ([(4, 8, 128, 64), (4, 8, 128, 64), (4, 8, 128, 64)], {}),
+    "flash_attention":
+        ([(4, 8, 128, 64), (4, 8, 128, 64), (4, 8, 128, 64)], {}),
+    "softmax_cross_entropy": ("sce", {}),
+    "one_hot": ("one_hot", {"depth": 100}),
+    "take": ("take", {}),
+    "batch_take": ("batch_take", {}),
+    "UpSampling": ([("B", 8, 16, 16)], {"scale": 2,
+                                        "sample_type": "nearest"}),
+    "BilinearResize2D": ([("B", 8, 16, 16)], {"height": 32, "width": 32}),
+    "box_iou": ("boxes2", {}),
+    "box_nms": ("nms", {"topk": 50}),
+    "multibox_prior": ([("B", 8, 16, 16)], {"sizes": (0.5, 0.25),
+                                            "ratios": (1.0, 2.0)}),
+}
+
+ARGSPECS = {**_UNARY_1D, **_REDUCE, **_BINARY, **_SCALAR, **_MATMUL, **_NN}
+
+
+def _make_inputs(nd, spec, batch):
+    rng = np.random.RandomState(0)
+    if spec == "spd":
+        a = rng.rand(8, 64, 64).astype(np.float32)
+        return [nd.array(a @ a.transpose(0, 2, 1)
+                         + 8 * np.eye(64, dtype=np.float32))]
+    if spec == "tri":
+        return [nd.array(np.tril(rng.rand(8, 64, 64)).astype(np.float32)
+                         + 2 * np.eye(64, dtype=np.float32))]
+    if spec == "tri_b":
+        tri = np.tril(rng.rand(8, 64, 64)).astype(np.float32) \
+            + 2 * np.eye(64, dtype=np.float32)
+        return [nd.array(tri), nd.array(rng.rand(8, 64, 64
+                                                 ).astype(np.float32))]
+    if spec == "embedding":
+        return [nd.array(rng.randint(0, 1000, (batch, 32)
+                                     ).astype(np.int32)),
+                nd.array(rng.rand(1000, 64).astype(np.float32))]
+    if spec == "sce":
+        return [nd.array(rng.rand(batch, 100).astype(np.float32)),
+                nd.array(rng.randint(0, 100, (batch,)).astype(np.float32))]
+    if spec == "one_hot":
+        return [nd.array(rng.randint(0, 100, (batch,)).astype(np.float32))]
+    if spec == "take":
+        return [nd.array(rng.rand(1000, 64).astype(np.float32)),
+                nd.array(rng.randint(0, 1000, (batch,)
+                                     ).astype(np.float32))]
+    if spec == "batch_take":
+        return [nd.array(rng.rand(batch, 64).astype(np.float32)),
+                nd.array(rng.randint(0, 64, (batch,)).astype(np.float32))]
+    if spec == "boxes2":
+        b = rng.rand(64, 4).astype(np.float32)
+        b[:, 2:] = b[:, :2] + 0.2
+        return [nd.array(b), nd.array(b)]
+    if spec == "nms":
+        r = rng.rand(4, 200, 6).astype(np.float32)
+        r[..., 4:6] = r[..., 2:4] + 0.2
+        return [nd.array(r)]
+    arrays = []
+    for shape in spec:
+        shape = tuple(batch if s == "B" else s for s in shape)
+        arrays.append(nd.array(rng.rand(*shape).astype(np.float32)))
+    return arrays
+
+
+def run_op(mx, name, batch, iters):
+    from incubator_mxnet_tpu.ndarray import invoke_op
+    from incubator_mxnet_tpu.ops import registry
+
+    spec, kwargs = ARGSPECS[name]
+    inputs = _make_inputs(mx.nd, spec, batch)
+    opdef = registry.get(name)
+
+    def call():
+        return invoke_op(name, *inputs, **kwargs)
+
+    out = call()
+    (out[0] if isinstance(out, tuple) else out).asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = call()
+    (out[0] if isinstance(out, tuple) else out).asnumpy()
+    fwd_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    bwd_ms = None
+    if opdef.differentiable:
+        from incubator_mxnet_tpu import autograd
+
+        x = inputs[0]
+        x.attach_grad()
+        with autograd.record():
+            out = call()
+            head = out[0] if isinstance(out, tuple) else out
+        head.backward(mx.nd.ones_like(head))
+        x.grad.asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with autograd.record():
+                out = call()
+                head = out[0] if isinstance(out, tuple) else out
+            head.backward(mx.nd.ones_like(head))
+        x.grad.asnumpy()
+        bwd_ms = (time.perf_counter() - t0) / iters * 1e3
+    return fwd_ms, bwd_ms
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="",
+                    help="comma-separated subset (default: all covered)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops import registry
+
+    all_ops = registry.list_ops()
+    wanted = [o for o in args.ops.split(",") if o] or all_ops
+    covered = [o for o in wanted if o in ARGSPECS]
+    skipped = [o for o in wanted if o not in ARGSPECS]
+
+    rows = []
+    for name in covered:
+        try:
+            fwd, bwd = run_op(mx, name, args.batch, args.iters)
+            rows.append({"op": name, "fwd_ms": round(fwd, 4),
+                         "bwd_ms": None if bwd is None else round(bwd, 4)})
+        except Exception as e:  # keep sweeping
+            rows.append({"op": name, "error": str(e)[:120]})
+    rows.sort(key=lambda r: r.get("fwd_ms") or 0, reverse=True)
+
+    if args.json:
+        print(json.dumps({"results": rows, "skipped": skipped}, indent=1))
+        return
+    print(f"# opperf: {len(covered)} covered / {len(wanted)} requested "
+          f"(registry total {len(all_ops)}); batch={args.batch}")
+    print(f"{'op':36} {'fwd ms':>9} {'fwd+bwd ms':>11}")
+    for r in rows:
+        if "error" in r:
+            print(f"{r['op']:36} ERROR {r['error']}")
+        else:
+            b = "-" if r["bwd_ms"] is None else f"{r['bwd_ms']:.3f}"
+            print(f"{r['op']:36} {r['fwd_ms']:9.3f} {b:>11}")
+    if skipped:
+        print(f"# skipped (no argspec): {len(skipped)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""BERT pretraining (MLM+NSP) — BASELINE.json config[2] (reference
+GluonNLP scripts/bert): fused SPMD step over the device mesh, bf16,
+optional tensor/sequence parallel sharding rules, sharded checkpointing.
+
+Single chip:
+    python examples/bert/pretrain_bert.py --layers 2 --units 128 --iters 5
+Multi-host (per process, under tools/launch.py):
+    python tools/launch.py -n 2 --launcher local \
+        python examples/bert/pretrain_bert.py --distributed
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=30522)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--units", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways (Megatron col/row rules)")
+    ap.add_argument("--attention-impl", default="xla",
+                    choices=["xla", "pallas", "ring"])
+    ap.add_argument("--distributed", action="store_true")
+    ap.add_argument("--ckpt-prefix", default="")
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, models, parallel
+    from jax.sharding import PartitionSpec as P
+
+    if args.distributed:
+        parallel.init_distributed()
+
+    net = models.BERTModel(
+        vocab_size=args.vocab, units=args.units,
+        hidden_size=4 * args.units, num_layers=args.layers,
+        num_heads=args.heads, max_length=max(512, args.seq_len),
+        dropout=0.0, attention_impl=args.attention_impl)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    T = args.seq_len
+    net(mx.nd.zeros((2, T), dtype="int32"),
+        mx.nd.zeros((2, T), dtype="int32"),
+        mx.nd.array(np.full((2,), T), dtype="int32"))
+
+    if args.tp > 1:
+        parallel.shard_params(net, {
+            r"ffn1\.weight": P("model", None),
+            r"ffn2\.weight": P(None, "model"),
+            r"(query|key|value)\.weight": P("model", None),
+            r"proj\.weight": P(None, "model"),
+        })
+        mesh = parallel.make_mesh({"data": -1, "model": args.tp})
+    else:
+        mesh = parallel.make_mesh({"data": -1})
+
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def pretrain_loss(seq_out, pooled, mlm_scores, nsp_scores,
+                      mlm_label, nsp_label):
+        return ce(mlm_scores, mlm_label).mean() + \
+            ce(nsp_scores, nsp_label).mean()
+
+    trainer = parallel.SPMDTrainer(net, pretrain_loss, "adamw",
+                                   {"learning_rate": args.lr, "wd": 0.01},
+                                   mesh=mesh)
+    rng = np.random.RandomState(0)
+    B = args.batch_size
+    for it in range(args.iters):
+        tok = rng.randint(0, args.vocab, (B, T)).astype(np.int32)
+        seg = np.zeros((B, T), np.int32)
+        vl = np.full((B,), T, np.int32)
+        mlm_y = rng.randint(0, args.vocab, (B, T)).astype(np.float32)
+        nsp_y = rng.randint(0, 2, (B,)).astype(np.float32)
+        loss = trainer.step([tok, seg, vl], [mlm_y, nsp_y])
+        print(f"iter {it}: loss {float(loss):.4f}")
+
+    if args.ckpt_prefix:
+        parallel.save_sharded(args.ckpt_prefix, trainer)
+        print(f"sharded checkpoint at {args.ckpt_prefix}.manifest.json")
+
+
+if __name__ == "__main__":
+    main()

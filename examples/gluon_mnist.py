@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Gluon MLP on MNIST — BASELINE.json config[0] and the reference's
+first-steps example (example/gluon/mnist.py): same script runs on
+``mx.cpu()`` or ``mx.tpu()`` by swapping the context.
+
+    python examples/gluon_mnist.py --epochs 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force mx.cpu() even if a TPU is present")
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, metric
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+    ctx = mx.cpu() if args.cpu or mx.num_tpus() == 0 else mx.tpu()
+    print(f"training on {ctx}")
+
+    train_data = DataLoader(
+        MNIST(train=True).transform_first(transforms.ToTensor()),
+        batch_size=args.batch_size, shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(init="xavier", ctx=ctx)
+    net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    acc = metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        acc.reset()
+        last = 0.0
+        for x, y in train_data:
+            x = x.as_in_context(ctx).reshape(x.shape[0], -1)
+            y = y.as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            acc.update(y, out)
+            last = float(loss.mean().asnumpy())
+        print(f"epoch {epoch}: loss {last:.4f} acc {acc.get()[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Canonical image-classification training script (reference
+example/image-classification/train_imagenet.py + common/fit.py).
+
+Model-zoo network + Gluon Trainer + kvstore, with AMP and the fused SPMD
+path as opt-ins. Uses synthetic data by default (no-network environment);
+point --data-rec at an im2rec-packed RecordIO file for real data.
+
+    python examples/image_classification/train.py --network resnet18_v1 \
+        --batch-size 64 --epochs 1 --iters-per-epoch 20
+    python examples/image_classification/train.py --spmd --amp
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def get_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet18_v1")
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--iters-per-epoch", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=1e-4)
+    ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--amp", action="store_true",
+                    help="bf16 AMP with dynamic loss scaling")
+    ap.add_argument("--spmd", action="store_true",
+                    help="fused SPMD step over the device mesh (the "
+                         "performance path)")
+    ap.add_argument("--data-rec", default="",
+                    help="RecordIO file from tools/im2rec.py "
+                         "(default: synthetic)")
+    ap.add_argument("--save-prefix", default="")
+    return ap.parse_args(argv)
+
+
+def synthetic_batches(args, rng):
+    shape = (args.batch_size, 3, args.image_size, args.image_size)
+    while True:
+        x = rng.rand(*shape).astype(np.float32)
+        y = rng.randint(0, args.classes,
+                        (args.batch_size,)).astype(np.float32)
+        yield x, y
+
+
+def record_batches(args):
+    import incubator_mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=args.data_rec, data_shape=(3, args.image_size,
+                                               args.image_size),
+        batch_size=args.batch_size, shuffle=True)
+    while True:
+        it.reset()
+        for batch in it:
+            yield batch.data[0].asnumpy(), batch.label[0].asnumpy()
+
+
+def main(argv=None):
+    args = get_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import amp, autograd, gluon, metric, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = getattr(vision, args.network)(classes=args.classes)
+    net.initialize(init="xavier")
+    net.hybridize()
+    if args.amp or args.spmd:
+        net.cast("bfloat16")
+    dtype = "bfloat16" if (args.amp or args.spmd) else "float32"
+    net(mx.nd.zeros((2, 3, args.image_size, args.image_size), dtype=dtype))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = record_batches(args) if args.data_rec else \
+        synthetic_batches(args, np.random.RandomState(0))
+    acc = metric.Accuracy()
+
+    opt_params = {"learning_rate": args.lr, "momentum": args.momentum,
+                  "wd": args.wd}
+
+    if args.spmd:
+        mesh = parallel.make_mesh({"data": -1})
+        trainer = parallel.SPMDTrainer(net, loss_fn, args.optimizer,
+                                       opt_params, mesh=mesh)
+        for epoch in range(args.epochs):
+            tic, n = time.time(), 0
+            for _ in range(args.iters_per_epoch):
+                x, y = next(batches)
+                loss = trainer.step(x.astype(dtype), y)
+                n += args.batch_size
+            print(f"epoch {epoch}: loss {float(loss):.4f} "
+                  f"{n / (time.time() - tic):.1f} img/s (spmd)")
+        trainer.sync_to_net()
+    else:
+        if args.amp:
+            amp.init(target_dtype="bfloat16")
+        trainer = gluon.Trainer(net.collect_params(), args.optimizer,
+                                opt_params, kvstore=args.kvstore)
+        if args.amp:
+            amp.init_trainer(trainer)
+        for epoch in range(args.epochs):
+            tic, n = time.time(), 0
+            acc.reset()
+            for _ in range(args.iters_per_epoch):
+                x, y = next(batches)
+                xb = mx.nd.array(x, dtype=dtype)
+                yb = mx.nd.array(y)
+                with autograd.record():
+                    out = net(xb)
+                    loss = loss_fn(out, yb)
+                    if args.amp:
+                        with amp.scale_loss(loss, trainer) as scaled:
+                            autograd.backward(scaled)
+                    else:
+                        loss.backward()
+                trainer.step(args.batch_size)
+                acc.update(yb, out)
+                n += args.batch_size
+            print(f"epoch {epoch}: loss {float(loss.mean().asnumpy()):.4f} "
+                  f"acc {acc.get()[1]:.3f} "
+                  f"{n / (time.time() - tic):.1f} img/s")
+        if args.amp:
+            amp.deinit()
+
+    if args.save_prefix:
+        net.export(args.save_prefix)
+        print(f"exported to {args.save_prefix}-symbol.json/.params")
+
+
+if __name__ == "__main__":
+    main()

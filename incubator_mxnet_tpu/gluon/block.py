@@ -545,7 +545,8 @@ class HybridBlock(Block):
             hook(self, args)
         if args and all(isinstance(a, NDArray) for a in args):
             # remember the call signature so export() can replay it
-            self._last_input_spec = [(a.shape, str(a.dtype)) for a in args]
+            # (dtype objects, not strings — keep the hot path cheap)
+            self._last_input_spec = [(a.shape, a.dtype) for a in args]
         from ..ndarray.ndarray import _graph_recorders
 
         out = None

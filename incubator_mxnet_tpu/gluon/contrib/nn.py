@@ -1,0 +1,42 @@
+"""gluon.contrib.nn — SyncBatchNorm (reference
+``gluon/contrib/nn/basic_layers.py`` SyncBatchNorm).
+
+Reference semantics: batch statistics are synchronized across ALL devices
+processing a batch (via an NCCL-like all-reduce of the moments) instead of
+each device normalizing with its slice's stats.
+
+TPU-native: under the fused SPMD step the batch axis is sharded over the
+mesh and the statistics reductions (``jnp.mean``/``jnp.var``) are GLOBAL —
+XLA inserts the cross-chip AllReduce automatically — so cross-device
+synchronization is the default behavior of plain BatchNorm on this
+framework (verified by tests/test_parallel.py's sharded-stats test). This
+class exists for API parity: it accepts and records the reference's
+``num_devices`` argument and is otherwise identical.
+"""
+
+from __future__ import annotations
+
+from ..nn.basic_layers import BatchNorm
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference
+    ``gluon.contrib.nn.SyncBatchNorm``). See module docstring: under SPMD
+    the sync is inherent; ``num_devices`` is accepted for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", prefix=None,
+                 params=None, **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, prefix=prefix, params=params)
+        self._num_devices = num_devices

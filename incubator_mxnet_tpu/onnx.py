@@ -1,0 +1,107 @@
+"""``mx.onnx`` — deployment-interchange export/import.
+
+Capability parity with reference ``python/mxnet/onnx`` (``mx2onnx``
+export / ``onnx2mx`` import): the reference translates symbol graphs to
+the ONNX interchange format for serving runtimes. No onnx package exists
+in this environment, and the TPU-native serving format is **StableHLO**
+(XLA's stable portable IR, produced via ``jax.export``) — so
+``export_model`` emits a single serialized StableHLO artifact with the
+parameters embedded as constants, loadable by any PJRT runtime (or back
+here with ``import_model``). The API mirrors the reference's
+file-oriented signature.
+
+    mx.onnx.export_model("net-symbol.json", "net-0000.params",
+                         [(1, 3, 224, 224)], "float32", "net.stablehlo")
+    fn = mx.onnx.import_model("net.stablehlo")
+    out = fn(x_numpy)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def export_model(sym, params, in_shapes=None, in_types="float32",
+                 onnx_file_path="model.stablehlo", verbose=False,
+                 dynamic=False, run_shape_inference=False):
+    """Serialize a symbol+params (file paths or objects) to StableHLO
+    (reference ``mx.onnx.export_model`` signature). Returns the path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from . import ndarray as ndmod
+    from .gluon.block import SymbolBlock
+    from .ndarray import NDArray
+
+    if isinstance(sym, str):
+        from . import symbol as sym_mod
+
+        symbol = sym_mod.load(sym)
+    else:
+        symbol = sym
+    if isinstance(params, str):
+        loaded = ndmod.load(params)
+    else:
+        loaded = {k: (v if isinstance(v, NDArray) else NDArray(
+            jnp.asarray(v))) for k, v in params.items()}
+
+    input_names = [n for n in symbol.list_arguments() if n not in loaded]
+    if in_shapes is None:
+        raise ValueError("in_shapes is required (one per graph input: "
+                         f"{input_names})")
+    if isinstance(in_types, (str, np.dtype, type)):
+        in_types = [in_types] * len(in_shapes)
+
+    blk = SymbolBlock(symbol, [__import__(
+        "incubator_mxnet_tpu.symbol", fromlist=["var"]).var(n)
+        for n in input_names])
+    blk_params = blk._collect_params_with_prefix()
+    for name, p in blk_params.items():
+        if name in loaded:
+            p.set_data(loaded[name])
+        else:
+            raise ValueError(f"params file missing {name!r}")
+
+    def pure(*xs):
+        outs = blk(*[NDArray(x) for x in xs])
+        if isinstance(outs, tuple):
+            return tuple(o._data for o in outs)
+        return outs._data
+
+    args = [jnp.zeros(s, dtype=t) for s, t in zip(in_shapes, in_types)]
+    exported = jexport.export(jax.jit(pure))(*args)
+    blob = exported.serialize()
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"exported {len(blob)} bytes of StableHLO to "
+              f"{onnx_file_path} (inputs {input_names})")
+    return onnx_file_path
+
+
+def import_model(model_file: str):
+    """Load a StableHLO artifact back as a callable (reference
+    ``onnx2mx`` import capability; runs via XLA on the current device)."""
+    from jax import export as jexport
+
+    with open(model_file, "rb") as f:
+        exported = jexport.deserialize(f.read())
+
+    def fn(*args):
+        import jax.numpy as jnp
+
+        from .ndarray import NDArray
+
+        arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        out = exported.call(*arrs)
+        if isinstance(out, (tuple, list)):
+            outs = [NDArray(o) for o in out]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        return NDArray(out)
+
+    fn.exported = exported
+    return fn

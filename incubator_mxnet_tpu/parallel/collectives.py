@@ -83,12 +83,17 @@ def _process_mesh():
     return _proc_mesh
 
 
-def allreduce_arrays(xs):
+def allreduce_arrays(xs, compression: Optional[str] = None):
     """Sum a LIST of identically-shaped-per-process arrays across all
     processes in ONE compiled XLA computation — the scaling path for
     multi-host gradients (replaces per-tensor host-side process_allgather;
     reference kvstore_dist push aggregation -> XLA collective over
-    ICI/DCN). Returns process-local arrays."""
+    ICI/DCN). Returns process-local arrays.
+
+    ``compression='int8'``: each process contributes per-tensor symmetric
+    int8 payloads + one fp32 scale (the reference 2-bit PS compression row;
+    EQuARX-style quantized allreduce — 4x less DCN traffic), dequantized
+    and summed inside the same compiled computation."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     if jax.process_count() == 1:
@@ -99,12 +104,43 @@ def allreduce_arrays(xs):
     local_dev = mesh.devices.flat[rank]
     shard_sharding = NamedSharding(mesh, PartitionSpec("proc"))
 
-    gxs = []
-    for x in xs:
-        local = jax.device_put(jnp.asarray(x)[None], local_dev)
-        gxs.append(jax.make_array_from_single_device_arrays(
-            (nproc,) + tuple(x.shape), shard_sharding, [local]))
+    def _to_global(arr):
+        local = jax.device_put(jnp.asarray(arr)[None], local_dev)
+        return jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(arr.shape), shard_sharding, [local])
 
+    if compression == "int8":
+        payload = []
+        for x in xs:
+            x = jnp.asarray(x)
+            scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-20) / 127.0
+            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+            payload.append((_to_global(q),
+                            _to_global(scale.reshape(1).astype(
+                                jnp.float32))))
+        key = ("int8",) + tuple(
+            (tuple(x.shape), str(x.dtype)) for x in xs)
+        fn = _allreduce_cache.get(key)
+        if fn is None:
+            replicated = NamedSharding(mesh, PartitionSpec())
+
+            def _sum_dequant(pairs):
+                out = []
+                for q, s in pairs:
+                    # dequant per contributing process, sum over processes
+                    deq = q.astype(jnp.float32) * s.reshape(
+                        (nproc,) + (1,) * (q.ndim - 1))
+                    out.append(jnp.sum(deq, axis=0))
+                return out
+
+            fn = jax.jit(_sum_dequant,
+                         out_shardings=[replicated for _ in xs])
+            _allreduce_cache[key] = fn
+        outs = fn(payload)
+        return [o.addressable_data(0).astype(x.dtype)
+                for o, x in zip(outs, xs)]
+
+    gxs = [_to_global(x) for x in xs]
     key = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
     fn = _allreduce_cache.get(key)
     if fn is None:

@@ -1,0 +1,170 @@
+"""Subgraph partition API — pluggable graph rewrites over the Symbol DAG.
+
+Capability parity with reference ``src/operator/subgraph/``
+(``SubgraphProperty`` + ``BuildSubgraph`` pass: oneDNN conv+bn+relu fusion,
+TensorRT offload, user partitioners via lib_api).
+
+TPU-native stance: XLA already fuses elementwise chains, so the pass's job
+here is SEMANTIC rewrites — e.g. replacing Convolution→BatchNorm(→relu)
+with one ``_fused_conv_bn`` op that folds the BN affine transform into the
+convolution weights (inference: running stats), halving the op count and
+letting XLA treat the folded weights as one constant.
+
+API (reference ``sym.optimize_for`` shape):
+    fused = partition_graph(sym, ["CONV_BN_FUSE"])
+    register_property(MyProperty())           # user partitioners
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import register as register_op
+from .symbol import Symbol, _Node
+
+
+class SubgraphProperty:
+    """A linear-chain pattern and its replacement (reference
+    ``SubgraphProperty``). ``pattern`` is a list of op names matched along
+    a single-consumer chain; ``rewrite(nodes)`` returns a replacement
+    _Node or None to skip the match."""
+
+    name = "base"
+    pattern: List[str] = []
+
+    def rewrite(self, nodes: List[_Node]) -> Optional[_Node]:
+        raise NotImplementedError
+
+
+_PROPERTIES: Dict[str, SubgraphProperty] = {}
+
+
+def register_property(prop: SubgraphProperty) -> SubgraphProperty:
+    _PROPERTIES[prop.name] = prop
+    return prop
+
+
+@register_op("_fused_conv_bn")
+def _fused_conv_bn(*arrs, bn_eps=1e-5, act_type=None, **conv_attrs):
+    """Convolution with inference-BatchNorm folded into its weights:
+    W' = W * gamma/sqrt(var+eps); b' = beta + (b - mean) * gamma/sqrt(..).
+    Inputs: (x, weight[, bias], gamma, beta, moving_mean, moving_var)."""
+    from ..ops.nn import convolution
+
+    no_bias = conv_attrs.get("no_bias", False)
+    if no_bias:
+        x, w, gamma, beta, mean, var = arrs
+        b = None
+    else:
+        x, w, b, gamma, beta, mean, var = arrs
+    scale = gamma * jax.lax.rsqrt(var + bn_eps)
+    w2 = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+    b0 = b if b is not None else jnp.zeros_like(mean)
+    b2 = beta + (b0 - mean) * scale
+    conv_attrs = dict(conv_attrs)
+    conv_attrs["no_bias"] = False
+    out = convolution(x, w2, b2, **conv_attrs)
+    if act_type:
+        from ..ops.nn import _ACTS
+
+        out = _ACTS[act_type](out)
+    return out
+
+
+class ConvBNFuse(SubgraphProperty):
+    """Convolution→BatchNorm (inference) → _fused_conv_bn."""
+
+    name = "CONV_BN_FUSE"
+    pattern = ["Convolution", "BatchNorm"]
+    act = None
+
+    def rewrite(self, nodes):
+        conv, bn = nodes[0], nodes[1]
+        if bn.inputs[0][0] is not conv or int(bn.attrs.get("axis", 1)) != 1:
+            return None
+        attrs = {k: v for k, v in conv.attrs.items()
+                 if not k.startswith("__")}
+        attrs["bn_eps"] = float(bn.attrs.get("eps",
+                                             bn.attrs.get("epsilon", 1e-5)))
+        if self.act is not None:
+            attrs["act_type"] = self.act
+        return _Node("_fused_conv_bn", conv.name + "_bn_fused", attrs,
+                     list(conv.inputs) + list(bn.inputs[1:]))
+
+
+class ConvBNActFuse(ConvBNFuse):
+    """Convolution→BatchNorm→Activation(relu) → one fused op."""
+
+    name = "CONV_BN_ACT_FUSE"
+    pattern = ["Convolution", "BatchNorm", "Activation"]
+
+    def rewrite(self, nodes):
+        act = nodes[2]
+        if act.attrs.get("act_type", "relu") != "relu":
+            return None
+        self_copy = ConvBNActFuse()
+        self_copy.act = "relu"
+        return ConvBNFuse.rewrite(self_copy, nodes[:2])
+
+
+register_property(ConvBNFuse())
+register_property(ConvBNActFuse())
+
+
+def partition_graph(symbol: Symbol, properties: Sequence) -> Symbol:
+    """Apply subgraph properties (names or objects) to a Symbol, returning
+    the rewritten Symbol (reference ``BuildSubgraph`` pass)."""
+    props = [p if isinstance(p, SubgraphProperty) else _PROPERTIES[p]
+             for p in properties]
+    nodes = symbol._topo_nodes()
+    consumers: Dict[int, List[_Node]] = {}
+    for n in nodes:
+        for parent, _ in n.inputs:
+            consumers.setdefault(id(parent), []).append(n)
+
+    # id(original chain-end node) -> replacement node; mid-chain nodes map
+    # too so nothing else may consume them
+    replaced: Dict[int, _Node] = {}
+
+    for prop in props:
+        for n in nodes:
+            if id(n) in replaced or n.op != prop.pattern[0]:
+                continue
+            chain = [n]
+            ok = True
+            for next_op in prop.pattern[1:]:
+                cons = consumers.get(id(chain[-1]), [])
+                if len(cons) != 1 or cons[0].op != next_op \
+                        or id(cons[0]) in replaced:
+                    ok = False
+                    break
+                chain.append(cons[0])
+            if not ok:
+                continue
+            new_node = prop.rewrite(chain)
+            if new_node is None:
+                continue
+            for c in chain:
+                replaced[id(c)] = new_node
+
+    if not replaced:
+        return symbol
+
+    rebuilt: Dict[int, _Node] = {}
+
+    def rebuild(node: _Node) -> _Node:
+        node = replaced.get(id(node), node)
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        new_inputs = [(rebuild(p), i) for p, i in node.inputs]
+        nn = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                   node.num_outputs)
+        rebuilt[id(node)] = nn
+        return nn
+
+    entries = [(rebuild(n), 0 if id(n) in replaced else i)
+               for n, i in symbol._entries]
+    return Symbol(entries)

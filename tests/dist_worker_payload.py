@@ -116,6 +116,20 @@ def main() -> int:
     np.testing.assert_allclose(
         g_rsp.tostype("default").asnumpy(), touched)
 
+    # a touched row whose cross-worker sum is exactly zero must still be
+    # overwritten (to zero), not left stale
+    kv6 = mx.kvstore.create("dist_sync")
+    kv6.init("z", mx.nd.ones((3, 2)) * 9.0)
+    sign = 1.0 if rank % 2 == 0 else -1.0
+    cancel = row_sparse_array(
+        (np.full((1, 2), sign, np.float32), np.array([1])), shape=(3, 2))
+    kv6.push("z", cancel)
+    pz = mx.nd.zeros((3, 2))
+    kv6.pull("z", out=pz)
+    want_row1 = sum(1.0 if r % 2 == 0 else -1.0 for r in range(size))
+    np.testing.assert_allclose(pz.asnumpy()[1], [want_row1] * 2)
+    np.testing.assert_allclose(pz.asnumpy()[0], [9.0] * 2)
+
     # ---- multi-host SPMD train step: global (proc x local-dev) mesh ------
     from incubator_mxnet_tpu import gluon, parallel
     from incubator_mxnet_tpu.gluon import nn

@@ -1,0 +1,193 @@
+"""SyncBatchNorm, subgraph partition pass, int8 quantization, gradient
+compression, and the StableHLO deploy export (SURVEY.md §2.1 subgraph/
+quantization rows, §2.2 ONNX row, §2.4 gradient-compression row)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu import symbol as sym
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm
+# ---------------------------------------------------------------------------
+def test_sync_batchnorm_api_and_forward():
+    from incubator_mxnet_tpu.gluon.contrib.nn import SyncBatchNorm
+
+    blk = SyncBatchNorm(in_channels=4, num_devices=8)
+    blk.initialize()
+    x = mx.nd.uniform(shape=(2, 4, 3, 3))
+    ref = nn.BatchNorm(in_channels=4)
+    ref.initialize()
+    np.testing.assert_allclose(blk(x).asnumpy(), ref(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# subgraph partition
+# ---------------------------------------------------------------------------
+def _conv_bn_act_graph():
+    data = sym.var("data")
+    conv = sym.Convolution(data, weight=sym.var("w"), bias=sym.var("b"),
+                           kernel=(3, 3), pad=(1, 1), num_filter=8)
+    bn = sym.BatchNorm(conv, gamma=sym.var("g"), beta=sym.var("be"),
+                       moving_mean=sym.var("mm"),
+                       moving_var=sym.var("mv"), eps=1e-5)
+    return sym.Activation(bn, act_type="relu")
+
+
+def _bindings(rng):
+    args = {"data": mx.nd.array(rng.rand(2, 3, 8, 8).astype(np.float32)),
+            "w": mx.nd.array(rng.rand(8, 3, 3, 3).astype(np.float32) * .1),
+            "b": mx.nd.array(rng.rand(8).astype(np.float32)),
+            "g": mx.nd.array(rng.rand(8).astype(np.float32) + 0.5),
+            "be": mx.nd.array(rng.rand(8).astype(np.float32))}
+    aux = {"mm": mx.nd.array(rng.rand(8).astype(np.float32)),
+           "mv": mx.nd.array(rng.rand(8).astype(np.float32) + 0.5)}
+    return args, aux
+
+
+def test_partition_conv_bn_act_fusion_equivalent():
+    from incubator_mxnet_tpu.symbol.partition import partition_graph
+
+    act = _conv_bn_act_graph()
+    fused = partition_graph(act, ["CONV_BN_ACT_FUSE"])
+    ops = [n.op for n in fused._topo_nodes() if not n.is_variable]
+    assert ops == ["_fused_conv_bn"]
+
+    rng = np.random.RandomState(0)
+    args, aux = _bindings(rng)
+    o1 = act.bind(mx.cpu(), dict(args), aux_states=dict(aux)) \
+        .forward(is_train=False)[0].asnumpy()
+    o2 = fused.bind(mx.cpu(), dict(args), aux_states=dict(aux)) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o2, o1, rtol=1e-4, atol=1e-5)
+
+
+def test_partition_conv_bn_without_act():
+    from incubator_mxnet_tpu.symbol.partition import partition_graph
+
+    data = sym.var("data")
+    conv = sym.Convolution(data, weight=sym.var("w"), bias=sym.var("b"),
+                           kernel=(3, 3), pad=(1, 1), num_filter=8)
+    bn = sym.BatchNorm(conv, gamma=sym.var("g"), beta=sym.var("be"),
+                       moving_mean=sym.var("mm"),
+                       moving_var=sym.var("mv"))
+    fused = partition_graph(bn, ["CONV_BN_FUSE"])
+    ops = [n.op for n in fused._topo_nodes() if not n.is_variable]
+    assert ops == ["_fused_conv_bn"]
+    rng = np.random.RandomState(1)
+    args, aux = _bindings(rng)
+    o1 = bn.bind(mx.cpu(), dict(args), aux_states=dict(aux)) \
+        .forward(is_train=False)[0].asnumpy()
+    o2 = fused.bind(mx.cpu(), dict(args), aux_states=dict(aux)) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o2, o1, rtol=1e-4, atol=1e-5)
+
+
+def test_partition_no_match_is_identity():
+    from incubator_mxnet_tpu.symbol.partition import partition_graph
+
+    data = sym.var("data")
+    out = sym.relu(data)
+    fused = partition_graph(out, ["CONV_BN_FUSE"])
+    assert [n.op for n in fused._topo_nodes()
+            if not n.is_variable] == ["relu"]
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization
+# ---------------------------------------------------------------------------
+def test_quantize_model_int8_accuracy():
+    from incubator_mxnet_tpu.contrib.quantization import quantize_model
+
+    rng = np.random.RandomState(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16), nn.Dense(8))
+    net.initialize(init="xavier")
+    calib = [mx.nd.array(rng.rand(4, 16).astype(np.float32))
+             for _ in range(3)]
+    x = mx.nd.array(rng.rand(8, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    qnet = quantize_model(net, calib_data=calib)
+    from incubator_mxnet_tpu.contrib.quantization import QuantizedDense
+
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ["QuantizedDense", "QuantizedDense"]
+    got = qnet(x).asnumpy()
+    # int8 inference: small relative error vs fp32
+    denom = np.maximum(np.abs(ref), 1e-2)
+    assert np.median(np.abs(got - ref) / denom) < 0.05
+
+
+def test_quantize_model_hybridized_net():
+    """Calibration must bypass a warmed CachedOp (eager hooks)."""
+    from incubator_mxnet_tpu.contrib.quantization import (QuantizedDense,
+                                                          quantize_model)
+
+    rng = np.random.RandomState(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4))
+    net.initialize(init="xavier")
+    net.hybridize()
+    x = mx.nd.array(rng.rand(4, 8).astype(np.float32))
+    net(x)                                   # warm the CachedOp
+    ref = net(x).asnumpy()
+    qnet = quantize_model(net, calib_data=[x])
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ["QuantizedDense", "QuantizedDense"]
+    got = qnet(x).asnumpy()
+    assert not np.array_equal(got, ref)      # actually quantized
+    denom = np.maximum(np.abs(ref), 1e-2)
+    assert np.median(np.abs(got - ref) / denom) < 0.05
+
+
+def test_quantized_fc_int32_accumulation():
+    from incubator_mxnet_tpu.ops.registry import get
+
+    rng = np.random.RandomState(1)
+    xq = rng.randint(-127, 128, (4, 64)).astype(np.int8)
+    wq = rng.randint(-127, 128, (16, 64)).astype(np.int8)
+    import jax.numpy as jnp
+
+    out = get("quantized_fully_connected").fn(
+        jnp.asarray(xq), jnp.asarray(wq), x_scale=jnp.float32(1.0),
+        w_scale=jnp.ones((16,), jnp.float32))
+    want = xq.astype(np.int64) @ wq.T.astype(np.int64)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+# ---------------------------------------------------------------------------
+# gradient compression (single-process path: API + quantization math)
+# ---------------------------------------------------------------------------
+def test_set_gradient_compression_api():
+    kv = mx.kvstore.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit"})
+    assert kv._compression == "int8"
+    kv.set_gradient_compression({"type": "int8"})
+    assert kv._compression == "int8"
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "fp4"})
+
+
+# ---------------------------------------------------------------------------
+# StableHLO deploy export (the mx.onnx row)
+# ---------------------------------------------------------------------------
+def test_onnx_export_import_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.BatchNorm(), nn.Dense(3))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(2, 5))
+    y0 = net(x)
+    sj, pp = net.export(str(tmp_path / "m"))
+
+    path = mx.onnx.export_model(sj, pp, [(2, 5)], "float32",
+                                str(tmp_path / "m.stablehlo"))
+    fn = mx.onnx.import_model(path)
+    y1 = fn(x)
+    np.testing.assert_allclose(y1.asnumpy(), y0.asnumpy(), rtol=1e-5,
+                               atol=1e-6)

@@ -53,7 +53,8 @@ def test_sigmoid_bce_loss():
     p = np.array([[0.0, 2.0, -2.0]])
     l = np.array([[0.0, 1.0, 0.0]])
     expect = (np.maximum(p, 0) - p * l + np.log1p(np.exp(-np.abs(p)))).mean(1)
-    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    # rtol covers the TPU transcendental approximation
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
 
 
 def test_huber_hinge_losses():

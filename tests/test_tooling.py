@@ -1,0 +1,105 @@
+"""Tooling-tier tests: im2rec packer, opperf harness, bandwidth bench,
+examples/ smoke (SURVEY.md §2.3)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_im2rec_list_and_pack_roundtrip(tmp_path):
+    from PIL import Image
+
+    root = tmp_path / "data"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = np.random.RandomState(i).randint(
+                0, 255, (10, 12, 3), np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+    prefix = str(tmp_path / "ds")
+
+    p = _run([os.path.join(REPO, "tools", "im2rec.py"), prefix, str(root),
+              "--list", "--shuffle", "0"])
+    assert p.returncode == 0, p.stderr
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {int(float(l.split("\t")[1])) for l in lines}
+    assert labels == {0, 1}
+
+    p = _run([os.path.join(REPO, "tools", "im2rec.py"), prefix, str(root)])
+    assert p.returncode == 0, p.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    from incubator_mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    hdr, img = recordio.unpack_img(rec.read_idx(0))
+    assert img.shape == (10, 12, 3)
+    assert hdr.label in (0.0, 1.0)
+
+
+def test_opperf_subset_runs():
+    p = _run([os.path.join(REPO, "benchmark", "opperf.py"),
+              "--ops", "relu,FullyConnected,Convolution,sum,_mul_scalar",
+              "--batch", "8", "--iters", "2", "--json"])
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout)
+    by_op = {r["op"]: r for r in out["results"]}
+    assert set(by_op) == {"relu", "FullyConnected", "Convolution", "sum",
+                          "_mul_scalar"}
+    for r in by_op.values():
+        assert "error" not in r, r
+        assert r["fwd_ms"] > 0
+
+
+def test_opperf_covers_majority_of_registry():
+    """The harness's argspec table must cover most of the op surface —
+    the opperf-analog completeness check."""
+    from benchmark.opperf import ARGSPECS
+    from incubator_mxnet_tpu.ops import registry
+
+    ops = registry.list_ops()
+    covered = [o for o in ops if o in ARGSPECS]
+    assert len(covered) >= len(ops) * 0.55, (
+        f"opperf covers {len(covered)}/{len(ops)}")
+
+
+def test_bandwidth_bench_runs():
+    p = _run([os.path.join(REPO, "tools", "bandwidth.py"),
+              "--min-mb", "0.25", "--max-mb", "0.5", "--iters", "2"])
+    assert p.returncode == 0, p.stderr
+    assert "GB/s" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_image_classification_runs():
+    p = _run([os.path.join(REPO, "examples", "image_classification",
+                           "train.py"), "--network", "resnet18_v1",
+              "--image-size", "32", "--batch-size", "8",
+              "--iters-per-epoch", "3", "--epochs", "1"])
+    assert p.returncode == 0, p.stderr
+    assert "img/s" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_lstm_ptb_runs():
+    p = _run([os.path.join(REPO, "examples", "rnn", "lstm_ptb.py"),
+              "--vocab", "50", "--embed", "16", "--hidden", "16",
+              "--seq-len", "8", "--batch-size", "4", "--iters", "3"])
+    assert p.returncode == 0, p.stderr
+    assert "perplexity" in p.stdout

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""kvstore communication micro-benchmark (reference
+tools/bandwidth/measure.py): times init/push/pull/pushpull over a sweep of
+tensor sizes and reports effective GB/s per operation.
+
+Run single-process (device kvstore over the local mesh) or under
+tools/launch.py for the dist kvstore:
+
+    python tools/bandwidth.py --kvstore device --max-mb 64
+    python tools/launch.py -n 2 --launcher local \
+        python tools/bandwidth.py --kvstore dist_sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--min-mb", type=float, default=0.25)
+    ap.add_argument("--max-mb", type=float, default=64.0)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+
+    if args.kvstore.startswith("dist"):
+        from incubator_mxnet_tpu.parallel import collectives
+
+        collectives.init_distributed()
+
+    kv = mx.kvstore.create(args.kvstore)
+    rank = getattr(kv, "rank", 0)
+    if rank == 0:
+        print(f"# kvstore={args.kvstore} workers={kv.num_workers}")
+        print(f"# {'MB':>8} {'push ms':>9} {'pull ms':>9} "
+              f"{'pushpull ms':>12} {'GB/s':>7}")
+
+    mb = args.min_mb
+    key = 0
+    while mb <= args.max_mb:
+        n = int(mb * 1024 * 1024 / 4)
+        val = mx.nd.array(np.random.rand(n).astype(np.float32))
+        out = mx.nd.zeros((n,))
+        kv.init(key, mx.nd.zeros((n,)))
+
+        def timed(fn):
+            fn()
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                fn()
+            out.asnumpy()  # sync
+            return (time.perf_counter() - t0) / args.iters * 1e3
+
+        t_push = timed(lambda: kv.push(key, val))
+        t_pull = timed(lambda: kv.pull(key, out=out))
+        t_pp = timed(lambda: kv.pushpull(key, val, out=out))
+        gbps = mb / 1024 / (t_pp / 1e3)
+        if rank == 0:
+            print(f"{mb:10.2f} {t_push:9.3f} {t_pull:9.3f} "
+                  f"{t_pp:12.3f} {gbps:7.2f}")
+        key += 1
+        mb *= 2
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""im2rec — pack an image dataset into RecordIO (reference tools/im2rec.py).
+
+Two modes, matching the reference CLI shape:
+
+1. List generation: ``python tools/im2rec.py PREFIX ROOT --list``
+   walks ROOT's class subdirectories and writes ``PREFIX.lst`` lines
+   ``index\\tlabel\\trelpath``.
+2. Packing: ``python tools/im2rec.py PREFIX ROOT`` reads ``PREFIX.lst``
+   and writes ``PREFIX.rec`` + ``PREFIX.idx`` (IndexedRecordIO) with each
+   record = IRHeader(label) + encoded image, shard-able via
+   ``--num-thread``-free sequential IO (the TPU input pipeline reads
+   these with ``io.ImageRecordIter``-class readers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix: str, root: str, shuffle: bool, train_ratio: float,
+              seed: int = 0) -> None:
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fn in sorted(os.listdir(cdir)):
+            if fn.lower().endswith(_IMG_EXTS):
+                entries.append((label, os.path.join(cls, fn)))
+    if not classes:
+        # flat directory: label 0 for everything
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(_IMG_EXTS):
+                entries.append((0, fn))
+    if shuffle:
+        random.Random(seed).shuffle(entries)
+
+    def write(path, rows, start=0):
+        with open(path, "w") as f:
+            for i, (label, rel) in enumerate(rows, start):
+                f.write(f"{i}\t{label}\t{rel}\n")
+
+    if train_ratio < 1.0:
+        cut = int(len(entries) * train_ratio)
+        write(f"{prefix}_train.lst", entries[:cut])
+        write(f"{prefix}_val.lst", entries[cut:])
+        print(f"wrote {prefix}_train.lst ({cut}) and "
+              f"{prefix}_val.lst ({len(entries) - cut})")
+    else:
+        write(f"{prefix}.lst", entries)
+        print(f"wrote {prefix}.lst ({len(entries)} entries)")
+
+
+def pack_records(prefix: str, root: str, quality: int, resize: int) -> None:
+    import numpy as np
+    from PIL import Image
+
+    from incubator_mxnet_tpu import recordio
+
+    lst = f"{prefix}.lst"
+    if not os.path.exists(lst):
+        raise SystemExit(f"{lst} not found; generate it with --list first")
+    rec = recordio.MXIndexedRecordIO(f"{prefix}.idx", f"{prefix}.rec", "w")
+    n = 0
+    with open(lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[2]
+            img = Image.open(os.path.join(root, rel)).convert("RGB")
+            if resize > 0:
+                w, h = img.size
+                scale = resize / min(w, h)
+                img = img.resize((max(1, int(w * scale)),
+                                  max(1, int(h * scale))))
+            header = recordio.IRHeader(0, label, idx, 0)
+            packed = recordio.pack_img(header, np.asarray(img),
+                                       quality=quality)
+            rec.write_idx(idx, packed)
+            n += 1
+    rec.close()
+    print(f"packed {n} records into {prefix}.rec (+ {prefix}.idx)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate PREFIX.lst instead of packing")
+    ap.add_argument("--shuffle", type=int, default=1)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    args = ap.parse_args(argv)
+    if args.list:
+        make_list(args.prefix, args.root, bool(args.shuffle),
+                  args.train_ratio)
+    else:
+        pack_records(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()
