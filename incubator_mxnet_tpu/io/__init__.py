@@ -345,3 +345,171 @@ class CSVIter(DataIter):
 
     def getpad(self):
         return self._inner.getpad()
+
+
+class ImageRecordIter(DataIter):
+    """High-throughput RecordIO image iterator (reference
+    ``src/io/iter_image_recordio_2.cc`` ImageRecordIter): background
+    prefetching record reads + multi-threaded JPEG decode through the
+    native C++ library (``native/mxtpu_io.cc``), pure-Python fallback
+    when the library is unavailable. Supports distributed sharding via
+    ``part_index``/``num_parts`` (round-robin by record)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, part_index=0, num_parts=1,
+                 preprocess_threads=4, prefetch_buffer=64, resize=-1,
+                 rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, seed=0,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._path = path_imgrec
+        self._data_shape = tuple(data_shape)      # (C, H, W)
+        self._label_width = label_width
+        self._shuffle = shuffle
+        self._pool = []
+        self._pool_target = max(8 * batch_size, 512)
+        self._resize = resize
+        self._rand_crop = rand_crop
+        self._part_index = part_index
+        self._num_parts = num_parts
+        self._threads = preprocess_threads
+        self._prefetch = prefetch_buffer
+        self._rand_mirror = rand_mirror
+        self._rng = np.random.RandomState(seed)
+        self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self._std = np.array([std_r, std_g, std_b], np.float32)
+        self._native = None
+        try:
+            from ..native import NativeRecordReader
+
+            self._native = NativeRecordReader(path_imgrec, prefetch_buffer)
+        except Exception:
+            from ..recordio import MXRecordIO
+
+            self._fallback = MXRecordIO(path_imgrec, "r")
+        self.provide_data = [("data", (batch_size,) + self._data_shape)]
+        self.provide_label = [("softmax_label",
+                               (batch_size,) if label_width == 1
+                               else (batch_size, label_width))]
+        self._record_pos = 0
+
+    def reset(self):
+        if self._native is not None:
+            self._native.reset()
+        else:
+            self._fallback.reset()
+        self._record_pos = 0
+        self._pool = []
+
+    def _read_record(self):
+        while True:
+            buf = (self._native.read() if self._native is not None
+                   else self._fallback.read())
+            if buf is None:
+                return None
+            idx = self._record_pos
+            self._record_pos += 1
+            if self._num_parts > 1 and idx % self._num_parts \
+                    != self._part_index:
+                continue
+            return buf
+
+    def _next_raw(self):
+        """One raw record honoring the shuffle buffer (streaming shuffle
+        like the reference's shuffle_chunk pool)."""
+        if not self._shuffle:
+            return self._read_record()
+        # fill the pool
+        while len(self._pool) < self._pool_target:
+            buf = self._read_record()
+            if buf is None:
+                break
+            self._pool.append(buf)
+        if not self._pool:
+            return None
+        i = self._rng.randint(len(self._pool))
+        self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+        return self._pool.pop()
+
+    def _fit(self, img):
+        """resize-short-side (if requested) + center/random crop to the
+        target (h, w), zero-padding when smaller."""
+        import jax
+        import jax.numpy as jnp
+
+        c, h, w = self._data_shape
+        ih, iw = img.shape[:2]
+        if self._resize > 0 and min(ih, iw) != self._resize:
+            scale = self._resize / min(ih, iw)
+            nh, nw = max(1, round(ih * scale)), max(1, round(iw * scale))
+            img = np.asarray(jax.image.resize(
+                jnp.asarray(img, jnp.float32), (nh, nw, 3), "bilinear"))
+            ih, iw = nh, nw
+        y0 = x0 = 0
+        if ih > h:
+            y0 = self._rng.randint(ih - h + 1) if self._rand_crop \
+                else (ih - h) // 2
+        if iw > w:
+            x0 = self._rng.randint(iw - w + 1) if self._rand_crop \
+                else (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if img.shape[:2] != (h, w):
+            canvas = np.zeros((h, w, 3), np.float32)
+            canvas[:img.shape[0], :img.shape[1]] = img
+            img = canvas
+        return np.asarray(img, np.float32)
+
+    def next(self):
+        from .. import recordio as _rec
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        c, h, w = self._data_shape
+        raw_imgs, labels = [], []
+        while len(raw_imgs) < self.batch_size:
+            buf = self._next_raw()
+            if buf is None:
+                break
+            header, img = _rec.unpack(buf)
+            lab = header.label
+            labels.append(np.atleast_1d(np.asarray(lab, np.float32))
+                          [:self._label_width])
+            raw_imgs.append(img)
+        if not raw_imgs:
+            raise StopIteration
+        pad = self.batch_size - len(raw_imgs)
+
+        n = len(raw_imgs)
+        x = np.zeros((n, h, w, 3), np.float32)
+        if self._native is not None:
+            from ..native import decode_jpeg_batch, jpeg_dims
+
+            dims = [jpeg_dims(r) for r in raw_imgs]
+            ch = max(max(d[0] for d in dims), h)
+            cw = max(max(d[1] for d in dims), w)
+            canvas, sizes = decode_jpeg_batch(raw_imgs, ch, cw,
+                                              self._threads)
+            for i, (gh, gw) in enumerate(sizes):
+                x[i] = self._fit(canvas[i, :gh, :gw])
+        else:
+            import io as _io
+
+            from PIL import Image
+
+            for i, rb in enumerate(raw_imgs):
+                im = np.asarray(Image.open(_io.BytesIO(rb)).convert("RGB"))
+                x[i] = self._fit(im)
+        if self._rand_mirror:
+            flip = self._rng.rand(n) < 0.5
+            x[flip] = x[flip, :, ::-1]
+        x = (x - self._mean) / self._std
+        x = np.transpose(x, (0, 3, 1, 2))         # NCHW like the reference
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:],
+                                            np.float32)])
+            labels += [np.zeros((self._label_width,), np.float32)] * pad
+        y = np.stack(labels)
+        if self._label_width == 1:
+            y = y[:, 0]
+        return DataBatch([NDArray(jnp.asarray(x))],
+                         [NDArray(jnp.asarray(y))], pad=pad)

@@ -1,0 +1,151 @@
+"""Native IO library loader (the C-API boundary; docs/NATIVE.md).
+
+Loads ``libmxtpu_io.so`` (built from ``native/mxtpu_io.cc``) via ctypes;
+on first import, if the library is missing but a toolchain is present, it
+is built in place (``make -C native``). Absent either, callers fall back
+to the pure-Python paths — capability is identical, throughput is not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmxtpu_io.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+
+
+def _build() -> bool:
+    if not os.path.isdir(_SRC_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       capture_output=True, timeout=240)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (pure-python fallback)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        l = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    l.mxio_reader_open.restype = ctypes.c_void_p
+    l.mxio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    l.mxio_reader_next.restype = ctypes.c_int
+    l.mxio_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t)]
+    l.mxio_reader_reset.argtypes = [ctypes.c_void_p]
+    l.mxio_reader_close.argtypes = [ctypes.c_void_p]
+    l.mxio_decode_jpeg.restype = ctypes.c_int
+    l.mxio_decode_jpeg.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    l.mxio_jpeg_dims.restype = ctypes.c_int
+    l.mxio_jpeg_dims.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    l.mxio_decode_batch.restype = ctypes.c_int
+    l.mxio_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    _LIB = l
+    return _LIB
+
+
+class NativeRecordReader:
+    """Prefetching RecordIO reader over the native library."""
+
+    def __init__(self, path: str, prefetch: int = 64):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native IO library unavailable")
+        if not os.path.isfile(path):
+            raise IOError(f"cannot open {path}: no such file")
+        self._lib = l
+        self._h = l.mxio_reader_open(path.encode(), prefetch)
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def read(self) -> Optional[bytes]:
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_size_t()
+        rc = self._lib.mxio_reader_next(self._h, ctypes.byref(buf),
+                                        ctypes.byref(n))
+        if rc == 0:
+            return None
+        if rc < 0:
+            raise IOError("corrupt RecordIO stream")
+        return ctypes.string_at(buf, n.value)
+
+    def reset(self) -> None:
+        self._lib.mxio_reader_reset(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def jpeg_dims(record: bytes):
+    """(height, width) from the JPEG header, no pixel decode."""
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        raise RuntimeError("native IO library unavailable")
+    buf = np.frombuffer(record, np.uint8)
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    rc = l.mxio_jpeg_dims(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                          len(record), ctypes.byref(h), ctypes.byref(w))
+    if rc != 0:
+        raise IOError("corrupt jpeg record")
+    return h.value, w.value
+
+
+def decode_jpeg_batch(records, h: int, w: int, threads: int = 4):
+    """Decode a list of jpeg byte strings into one (N, h, w, 3) uint8
+    batch (native, multi-threaded). Returns (batch, sizes (N, 2))."""
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        raise RuntimeError("native IO library unavailable")
+    n = len(records)
+    out = np.zeros((n, h, w, 3), np.uint8)
+    got = np.zeros((2 * n,), np.int32)
+    bufs = [np.frombuffer(r, np.uint8) for r in records]
+    srcs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+        *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) for b in bufs])
+    lens = (ctypes.c_size_t * n)(*[len(r) for r in records])
+    failed = l.mxio_decode_batch(
+        srcs, lens, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        h, w, got.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), threads)
+    if failed:
+        raise IOError(f"{failed} jpeg records failed to decode")
+    return out, got.reshape(n, 2)

@@ -387,3 +387,125 @@ contrib.index_array = _this.index_array
 from ..ops import pallas_attention as _pallas_attention  # noqa: F401
 
 flash_attention = _wrap("flash_attention", 3)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops, samplers, image namespace, misc (ops/optimizer_ops,
+# ops/more)
+# ---------------------------------------------------------------------------
+from ..ops import optimizer_ops as _opt_ops  # noqa: F401
+from ..ops import more as _more  # noqa: F401
+
+def _wrap_update(name, narr, n_state):
+    """Optimizer update ops with reference in-place semantics: the first
+    ``narr`` args are arrays; updated weight writes to ``out`` (or arg0)
+    and the trailing ``n_state`` array args (momentum/mean/var/...) are
+    rebound in place, mirroring the reference's mutate-inputs ops."""
+    opdef = _registry.get(name)
+
+    def op(*args, out=None, **kwargs):
+        arrays = list(args[:narr])
+        res = invoke(opdef.fn, arrays, kwargs, name=opdef.name,
+                     differentiable=False)
+        outs = list(res) if isinstance(res, tuple) else [res]
+        tgt = out if out is not None else arrays[0]
+        tgt._set_data(outs[0]._data)
+        # trailing states: last n_state array args, in op output order
+        for o, a in zip(outs[1:], arrays[narr - n_state:]):
+            a._set_data(o._data)
+        return res
+
+    op.__name__ = name
+    return op
+
+
+for _n, _k, _s in [("sgd_update", 2, 0), ("sgd_mom_update", 3, 1),
+                   ("mp_sgd_update", 3, 1), ("mp_sgd_mom_update", 4, 2),
+                   ("nag_mom_update", 3, 1), ("adam_update", 4, 2),
+                   ("adamw_update", 4, 2), ("rmsprop_update", 3, 1),
+                   ("rmspropalex_update", 5, 3), ("ftrl_update", 4, 2),
+                   ("signsgd_update", 2, 0), ("signum_update", 3, 1)]:
+    setattr(_this, _n, _wrap_update(_n, _k, _s))
+
+for _n, _k in [("lamb_update_phase1", 4), ("lamb_update_phase2", 4),
+               ("amp_cast", 1), ("all_finite", 1),
+               ("LRN", 1), ("softmin", 1), ("masked_softmax", 2),
+               ("masked_log_softmax", 2), ("identity", 1),
+               ("add_n", 0), ("argmax_channel", 1), ("im2col", 1),
+               ("col2im", 1), ("Correlation", 2),
+               ("stop_gradient_op", 1)]:
+    if _k == 0:
+        setattr(_this, _n, _wrap(_n, 0, variadic=True))
+    else:
+        setattr(_this, _n, _wrap(_n, _k))
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, **kwargs):
+    args = [data, label]
+    if data_lengths is not None:
+        args.append(data_lengths)
+        kwargs.setdefault("use_data_lengths", True)
+    if label_lengths is not None:
+        args.append(label_lengths)
+        kwargs.setdefault("use_label_lengths", True)
+    has_dl = data_lengths is not None
+
+    def fn(*arrs, **kw):
+        d, l = arrs[0], arrs[1]
+        dl = arrs[2] if has_dl and len(arrs) > 2 else None
+        ll = arrs[3] if has_dl and len(arrs) > 3 else (
+            arrs[2] if (not has_dl) and len(arrs) > 2 else None)
+        return _registry.get("CTCLoss").fn(d, l, dl, ll, **kw)
+
+    return invoke(fn, args, kwargs, name="ctc_loss")
+
+
+CTCLoss = ctc_loss
+
+multi_sgd_update = _wrap("multi_sgd_update", 0, variadic=True)
+multi_sgd_mom_update = _wrap("multi_sgd_mom_update", 0, variadic=True)
+amp_multicast = _wrap("amp_multicast", 0, variadic=True)
+multi_all_finite = _wrap("multi_all_finite", 0, variadic=True)
+
+
+def DeformableConvolution(data, offset, weight, bias=None, **kwargs):
+    args = [data, offset, weight] + ([bias] if bias is not None else [])
+    if bias is None:
+        kwargs["no_bias"] = True
+
+    def fn(*arrs, **kw):
+        b = arrs[3] if len(arrs) > 3 else None
+        return _registry.get("DeformableConvolution").fn(
+            arrs[0], arrs[1], arrs[2], b, **kw)
+
+    return invoke(fn, args, kwargs, name="DeformableConvolution")
+
+
+def Crop(data, shape_like=None, **kwargs):
+    args = [data] + ([shape_like] if shape_like is not None else [])
+
+    def fn(*arrs, **kw):
+        sl = arrs[1] if len(arrs) > 1 else None
+        return _registry.get("Crop").fn(arrs[0], sl, **kw)
+
+    return invoke(fn, args, kwargs, name="Crop", differentiable=False)
+
+
+# per-parameter samplers: mx.nd.sample_uniform(low_nd, high_nd, shape=...)
+for _n, _k in [("sample_uniform", 2), ("sample_normal", 2),
+               ("sample_gamma", 2), ("sample_exponential", 1),
+               ("sample_poisson", 1), ("sample_negative_binomial", 2)]:
+    setattr(_this, _n, _wrap(_n, _k))
+
+# nd.image namespace (reference mx.nd.image.*)
+image = _ModuleType(__name__ + ".image")
+for _n, _k in [("image_to_tensor", 1), ("image_normalize", 1),
+               ("image_resize", 1), ("image_crop", 1),
+               ("image_flip_left_right", 1),
+               ("image_flip_top_bottom", 1),
+               ("image_random_flip_left_right", 1)]:
+    setattr(image, _n.replace("image_", ""), _wrap(_n, _k))
+_sys.modules[image.__name__] = image
+
+contrib.DeformableConvolution = DeformableConvolution
+contrib.ctc_loss = ctc_loss

@@ -9,4 +9,6 @@ from . import linalg  # noqa: F401  (registers linalg family)
 from . import misc    # noqa: F401  (registers indexing/spatial/loss ops)
 from . import rnn_op  # noqa: F401  (registers fused RNN op)
 from . import pallas_attention  # noqa: F401  (registers flash_attention)
+from . import optimizer_ops  # noqa: F401  (registers update ops)
+from . import more  # noqa: F401  (registers samplers/image/misc ops)
 from .registry import get, list_ops, register  # noqa: F401

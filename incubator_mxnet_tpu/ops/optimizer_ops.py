@@ -1,0 +1,275 @@
+"""Optimizer update operators.
+
+Capability parity with reference ``src/operator/optimizer_op.cc`` — in the
+reference every optimizer step IS an op (``sgd_update``, ``adam_update``,
+``lamb_update_phase1/2``, multi-tensor ``multi_sgd_*``, mixed-precision
+``mp_sgd_*``), invoked by python ``Optimizer.update``. This module restores
+that op surface; ``mx.optimizer`` continues to use its jit-cached fused
+updates (same math) while these ops serve direct callers and opperf.
+
+All registry ops are functional: they RETURN the updated tensors (weight,
+state...) instead of mutating — the XLA-native form. The ``mx.nd``
+wrappers (ndarray/__init__.py ``_wrap_update``) then rebind the returned
+buffers onto ``out``/the input handles, so imperative callers get the
+reference's mutate-in-place semantics (``nd.sgd_update(w, g, out=w)``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd(grad, weight, wd, rescale, clip):
+    g = grad * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """Reference optimizer_op.cc SGDUpdate: w -= lr * (rescale*g + wd*w)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    return weight - lr * g
+
+
+@register("sgd_mom_update")
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """Returns (weight, mom)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    mom2 = momentum * mom - lr * g
+    return weight + mom2, mom2
+
+
+@register("mp_sgd_update")
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """Mixed precision: fp32 master weight update, low-precision copy out.
+    Returns (weight, weight32)."""
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update")
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Returns (weight, mom, weight32)."""
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    mom2 = momentum * mom - lr * g
+    w32 = weight32 + mom2
+    return w32.astype(weight.dtype), mom2, w32
+
+
+@register("nag_mom_update")
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (reference NAGMomUpdate). Returns (weight, mom)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    mom2 = momentum * mom + g
+    return weight - lr * (g + momentum * mom2), mom2
+
+
+@register("adam_update")
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """Reference AdamUpdate (no bias correction, like the C++ op).
+    Returns (weight, mean, var)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    mean2 = beta1 * mean + (1 - beta1) * g
+    var2 = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * mean2 / (jnp.sqrt(var2) + epsilon)
+    return w, mean2, var2
+
+
+@register("adamw_update")
+def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """Reference contrib adamw_update (decoupled weight decay).
+    Returns (weight, mean, var)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean2 = beta1 * mean + (1 - beta1) * g
+    var2 = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * mean2 / (jnp.sqrt(var2) + epsilon)
+                        + wd * weight)
+    return w, mean2, var2
+
+
+@register("rmsprop_update")
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    """Returns (weight, n)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    n2 = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n2 + epsilon)
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n2
+
+
+@register("rmspropalex_update")
+def rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    """Graves' RMSProp (reference RMSPropAlexUpdate).
+    Returns (weight, n, g_acc, delta)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad,
+                  clip_gradient if clip_gradient > 0 else None)
+    n2 = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    gacc2 = gamma1 * g_acc + (1 - gamma1) * g
+    d2 = gamma2 * delta - lr * g / jnp.sqrt(n2 - jnp.square(gacc2)
+                                            + epsilon)
+    return weight + d2, n2, gacc2, d2
+
+
+@register("ftrl_update")
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """Returns (weight, z, n)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n2 = n + jnp.square(g)
+    sigma = (jnp.sqrt(n2) - jnp.sqrt(n)) / lr
+    z2 = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z2) <= lamda1, jnp.zeros_like(weight),
+        -(z2 - jnp.sign(z2) * lamda1)
+        / ((beta + jnp.sqrt(n2)) / lr + wd))
+    return w, z2, n2
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight * (1 - lr * wd) - lr * jnp.sign(g)
+
+
+@register("signum_update")
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum (momentum sign SGD; reference folds wd*weight into the
+    gradient BEFORE the momentum/sign step). Returns (weight, mom)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    mom2 = momentum * mom - (1 - momentum) * g
+    w = weight * (1 - lr * wd_lh) + lr * jnp.sign(mom2)
+    return w, mom2
+
+
+@register("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Returns (update_direction, mean, var) (reference phase1)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean2 = beta1 * mean + (1 - beta1) * g
+    var2 = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_hat, v_hat = mean2, var2
+    if bias_correction:
+        m_hat = mean2 / (1 - beta1 ** t)
+        v_hat = var2 / (1 - beta2 ** t)
+    upd = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    return upd, mean2, var2
+
+
+@register("lamb_update_phase2")
+def lamb_update_phase2(weight, g_update, r1, r2, lr=0.001,
+                       lower_bound=-1.0, upper_bound=-1.0):
+    """w -= lr * trust_ratio * update (reference phase2)."""
+    r1 = jnp.maximum(r1, 0.0)
+    if lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2,
+                      jnp.ones_like(r1))
+    return weight - lr * ratio * g_update
+
+
+@register("multi_sgd_update")
+def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    """Aggregated multi-tensor SGD (reference MultiSGDUpdate): args are
+    (w0, g0, w1, g1, ...); returns the updated weights."""
+    n = num_weights if num_weights is not None else len(args) // 2
+    outs = []
+    for i in range(n):
+        w, g = args[2 * i], args[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update")
+def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=None):
+    """args = (w0, g0, m0, w1, g1, m1, ...); returns (w0', m0', w1', ...)"""
+    n = num_weights if num_weights is not None else len(args) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, m2 = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([w2, m2])
+    return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# AMP support ops (reference amp_cast.cc / all_finite.cc)
+# ---------------------------------------------------------------------------
+@register("amp_cast")
+def amp_cast(x, dtype=jnp.float16):
+    return x.astype(dtype)
+
+
+@register("amp_multicast")
+def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
+    """Cast a group of arrays to their widest (or narrowest) common type."""
+    dtypes = [a.dtype for a in arrays]
+    target = dtypes[0]
+    for d in dtypes[1:]:
+        target = jnp.promote_types(d, target) if not cast_narrow else (
+            d if jnp.finfo(d).bits < jnp.finfo(target).bits else target)
+    return tuple(a.astype(target) for a in arrays)
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output=True):
+    """1.0 if every element is finite else 0.0 (loss-scaler probe)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape(1)

@@ -1,0 +1,263 @@
+// mxtpu_io — native data-pipeline core.
+//
+// The TPU-native equivalent of the reference's C++ IO stack
+// (src/io/iter_image_recordio_2.cc + dmlc-core recordio.h + OpenCV decode):
+// RecordIO framing parse, a background prefetch reader thread, and
+// multi-threaded libjpeg decode into caller-provided NHWC batches.
+// Exposed as a plain C ABI consumed from Python via ctypes (the repo's
+// C-API boundary; see docs/NATIVE.md).
+//
+// Build: make -C native   (g++ + libjpeg, both baked into the image)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Record {
+  std::vector<uint8_t> data;
+};
+
+// Bounded-queue prefetching RecordIO reader (dmlc ThreadedIter analog).
+class RecordReader {
+ public:
+  RecordReader(const char* path, int prefetch)
+      : path_(path), capacity_(prefetch > 0 ? prefetch : 64) {
+    Start();
+  }
+
+  ~RecordReader() { Stop(); }
+
+  // Returns false at EOF. The returned buffer stays valid until the next
+  // Next()/Reset() on this handle.
+  bool Next(const uint8_t** data, size_t* len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_nonempty_.wait(lk, [&] { return !queue_.empty() || done_; });
+    if (queue_.empty()) return false;
+    current_ = std::move(queue_.front());
+    queue_.pop();
+    cv_nonfull_.notify_one();
+    *data = current_.data.data();
+    *len = current_.data.size();
+    return true;
+  }
+
+  void Reset() {
+    Stop();
+    Start();
+  }
+
+  bool ok() const { return ok_; }
+
+ private:
+  void Start() {
+    done_ = false;
+    ok_ = true;
+    worker_ = std::thread([this] { ReadLoop(); });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_nonfull_.notify_all();
+    }
+    if (worker_.joinable()) worker_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    std::queue<Record>().swap(queue_);
+    stop_ = false;
+    done_ = true;
+  }
+
+  void ReadLoop() {
+    FILE* f = std::fopen(path_.c_str(), "rb");
+    if (!f) {
+      std::lock_guard<std::mutex> lk(mu_);
+      ok_ = false;
+      done_ = true;
+      cv_nonempty_.notify_all();
+      return;
+    }
+    while (true) {
+      uint32_t magic = 0, lrec = 0;
+      if (std::fread(&magic, 4, 1, f) != 1) break;
+      if (magic != kMagic) { ok_ = false; break; }
+      if (std::fread(&lrec, 4, 1, f) != 1) { ok_ = false; break; }
+      // upper 3 bits: continuation flag (unused by the python writer);
+      // lower 29 bits: record length
+      size_t len = lrec & ((1u << 29) - 1);
+      Record rec;
+      rec.data.resize(len);
+      if (len && std::fread(rec.data.data(), 1, len, f) != len) {
+        ok_ = false;
+        break;
+      }
+      // records are 4-byte aligned
+      size_t pad = (4 - (len & 3)) & 3;
+      if (pad) std::fseek(f, static_cast<long>(pad), SEEK_CUR);
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_nonfull_.wait(lk, [&] { return queue_.size() < capacity_ || stop_; });
+      if (stop_) break;
+      queue_.push(std::move(rec));
+      cv_nonempty_.notify_one();
+    }
+    std::fclose(f);
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    cv_nonempty_.notify_all();
+  }
+
+  std::string path_;
+  size_t capacity_;
+  std::queue<Record> queue_;
+  Record current_;
+  std::mutex mu_;
+  std::condition_variable cv_nonempty_, cv_nonfull_;
+  std::thread worker_;
+  bool stop_ = false;
+  bool done_ = false;
+  std::atomic<bool> ok_{true};
+};
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jmp;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+// Decode one JPEG into out (HWC uint8, RGB). Returns 0 on success.
+int DecodeJpeg(const uint8_t* src, size_t len, uint8_t* out, int out_h,
+               int out_w, int* got_h, int* got_w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(src),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int h = static_cast<int>(cinfo.output_height);
+  const int w = static_cast<int>(cinfo.output_width);
+  *got_h = h;
+  *got_w = w;
+  if (h > out_h || w > out_w) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 2;  // caller's buffer too small
+  }
+  std::vector<uint8_t> row(static_cast<size_t>(w) * 3);
+  JSAMPROW rows[1] = {row.data()};
+  int y = 0;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    jpeg_read_scanlines(&cinfo, rows, 1);
+    std::memcpy(out + static_cast<size_t>(y) * out_w * 3, row.data(),
+                static_cast<size_t>(w) * 3);
+    ++y;
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mxio_reader_open(const char* path, int prefetch) {
+  auto* r = new RecordReader(path, prefetch);
+  return r;
+}
+
+// 1 = record produced, 0 = EOF, -1 = corrupt stream
+int mxio_reader_next(void* handle, const uint8_t** data, size_t* len) {
+  auto* r = static_cast<RecordReader*>(handle);
+  if (!r->Next(data, len)) return r->ok() ? 0 : -1;
+  return 1;
+}
+
+void mxio_reader_reset(void* handle) {
+  static_cast<RecordReader*>(handle)->Reset();
+}
+
+void mxio_reader_close(void* handle) {
+  delete static_cast<RecordReader*>(handle);
+}
+
+int mxio_decode_jpeg(const uint8_t* src, size_t len, uint8_t* out,
+                     int out_h, int out_w, int* got_h, int* got_w) {
+  return DecodeJpeg(src, len, out, out_h, out_w, got_h, got_w);
+}
+
+// Header-only dimensions probe (no pixel decode). Returns 0 on success.
+int mxio_jpeg_dims(const uint8_t* src, size_t len, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(src),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *h = static_cast<int>(cinfo.image_height);
+  *w = static_cast<int>(cinfo.image_width);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode `n` jpegs (srcs/lens) into one NHWC uint8 batch with `threads`
+// workers; each image must fit (h, w). got_hw receives n*(h,w) pairs.
+// Returns number of failed decodes.
+int mxio_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
+                      uint8_t* out, int h, int w, int* got_hw,
+                      int threads) {
+  if (threads < 1) threads = 1;
+  std::atomic<int> next{0};
+  std::atomic<int> failed{0};
+  auto work = [&] {
+    int i;
+    while ((i = next.fetch_add(1)) < n) {
+      int gh = 0, gw = 0;
+      if (DecodeJpeg(srcs[i], lens[i],
+                     out + static_cast<size_t>(i) * h * w * 3, h, w, &gh,
+                     &gw) != 0) {
+        failed.fetch_add(1);
+      }
+      got_hw[2 * i] = gh;
+      got_hw[2 * i + 1] = gw;
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < threads - 1; ++t) pool.emplace_back(work);
+  work();
+  for (auto& th : pool) th.join();
+  return failed.load();
+}
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+"""Native IO library tests: RecordIO reader parity with the python
+implementation, threaded JPEG batch decode vs PIL, ImageRecordIter
+end-to-end (SURVEY.md §2.1 'C++ data pipeline' row; docs/NATIVE.md)."""
+
+import io as pyio
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio
+
+
+def _native_or_skip():
+    from incubator_mxnet_tpu import native
+
+    if native.lib() is None:
+        pytest.skip("native IO library unavailable (no toolchain)")
+    return native
+
+
+def _write_rec(tmp_path, n=8, size=(9, 11)):
+    from PIL import Image
+
+    path = str(tmp_path / "data.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write(recordio.pack_img(header, arr, quality=90))
+    rec.close()
+    return path
+
+
+def test_native_reader_matches_python(tmp_path):
+    native = _native_or_skip()
+    path = _write_rec(tmp_path, n=13)
+    py = recordio.MXRecordIO(path, "r")
+    nat = native.NativeRecordReader(path)
+    count = 0
+    while True:
+        a = py.read()
+        b = nat.read()
+        assert (a is None) == (b is None)
+        if a is None:
+            break
+        assert a == b
+        count += 1
+    assert count == 13
+    # reset replays from the start
+    nat.reset()
+    py2 = recordio.MXRecordIO(path, "r")
+    assert nat.read() == py2.read()
+    nat.close()
+
+
+def test_native_jpeg_decode_matches_pil(tmp_path):
+    from PIL import Image
+
+    native = _native_or_skip()
+    rng = np.random.RandomState(1)
+    arr = rng.randint(0, 255, (16, 20, 3), dtype=np.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+    raw = buf.getvalue()
+    batch, sizes = native.decode_jpeg_batch([raw, raw], 16, 20, threads=2)
+    assert batch.shape == (2, 16, 20, 3)
+    assert tuple(sizes[0]) == (16, 20)
+    ref = np.asarray(Image.open(pyio.BytesIO(raw)).convert("RGB"))
+    # both decoders are libjpeg: allow off-by-rounding differences
+    assert np.abs(batch[0].astype(int) - ref.astype(int)).max() <= 2
+    np.testing.assert_array_equal(batch[0], batch[1])
+
+
+def test_image_record_iter_end_to_end(tmp_path):
+    _native_or_skip()
+    path = _write_rec(tmp_path, n=10, size=(9, 11))
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 9, 11),
+                               batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 9, 11)
+    assert batches[0].label[0].shape == (4,)
+    assert batches[2].pad == 2
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               [0, 1, 2, 0])
+    # reset + re-iterate gives the same first labels
+    it.reset()
+    again = next(it)
+    np.testing.assert_allclose(again.label[0].asnumpy(), [0, 1, 2, 0])
+
+
+def test_image_record_iter_sharding(tmp_path):
+    _native_or_skip()
+    path = _write_rec(tmp_path, n=8)
+    part0 = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 9, 11),
+                                  batch_size=4, part_index=0, num_parts=2)
+    part1 = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 9, 11),
+                                  batch_size=4, part_index=1, num_parts=2)
+    l0 = next(part0).label[0].asnumpy()
+    l1 = next(part1).label[0].asnumpy()
+    np.testing.assert_allclose(l0, [0, 2, 1, 0])    # records 0,2,4,6
+    np.testing.assert_allclose(l1, [1, 0, 2, 1])    # records 1,3,5,7
+
+
+def test_runtime_reports_native_recordio():
+    from incubator_mxnet_tpu import native, runtime
+
+    feats = runtime.Features()
+    assert feats.is_enabled("RECORDIO_NATIVE") == (
+        native.lib() is not None)
+
+
+def test_image_record_iter_shuffle_and_resize(tmp_path):
+    _native_or_skip()
+    # variable-size images exercise the dims-probe + resize + crop path
+    from PIL import Image
+
+    path = str(tmp_path / "var.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    sizes = [(8, 8), (20, 14), (13, 30), (9, 9), (16, 16), (32, 12)]
+    for i, (ih, iw) in enumerate(sizes):
+        arr = rng.randint(0, 255, (ih, iw, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    arr, quality=90))
+    rec.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 10, 10),
+                               batch_size=6, resize=12, shuffle=True,
+                               seed=3)
+    batch = next(it)
+    assert batch.data[0].shape == (6, 3, 10, 10)
+    labels = sorted(batch.label[0].asnumpy().tolist())
+    assert labels == [0, 1, 2, 3, 4, 5]      # all records, some order
+    # shuffle actually permutes across epochs/seeds
+    it2 = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 10, 10),
+                                batch_size=6, resize=12, shuffle=False)
+    ordered = next(it2).label[0].asnumpy().tolist()
+    assert ordered == [0, 1, 2, 3, 4, 5]
+
+
+def test_native_reader_missing_file_raises():
+    native = _native_or_skip()
+    with pytest.raises(IOError, match="no such file"):
+        native.NativeRecordReader("/tmp/definitely_missing_424242.rec")
+
+
+def test_optimizer_update_out_semantics():
+    from incubator_mxnet_tpu import ndarray as nd
+
+    w = mx.nd.array(np.ones(4, np.float32))
+    g = mx.nd.array(np.full(4, 0.5, np.float32))
+    m = mx.nd.array(np.zeros(4, np.float32))
+    nd.sgd_mom_update(w, g, m, out=w, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.05, rtol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), -0.05, rtol=1e-6)  # in place
+
+
+def test_ctc_loss_with_lengths():
+    from incubator_mxnet_tpu import ndarray as nd
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(6, 2, 5).astype(np.float32))
+    label = mx.nd.array(np.array([[1, 2, -1], [3, 1, 2]], np.float32))
+    dl = mx.nd.array(np.array([6, 4], np.float32))
+    out = nd.ctc_loss(data, label, data_lengths=dl).asnumpy()
+    assert out.shape == (2,) and np.isfinite(out).all()

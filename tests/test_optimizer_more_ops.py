@@ -1,0 +1,266 @@
+"""Oracle tests: optimizer update ops, AMP ops, samplers, image ops,
+LRN/masked-softmax/im2col/Correlation/DeformableConvolution/CTC
+(reference test_operator.py optimizer/image sections; numpy as oracle)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).rand(*shape) * scale
+            ).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops
+# ---------------------------------------------------------------------------
+def test_sgd_update_oracle():
+    w, g = _r((4, 3), 0), _r((4, 3), 1)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                        rescale_grad=0.5).asnumpy()
+    np.testing.assert_allclose(out, w - 0.1 * (0.5 * g + 0.01 * w),
+                               rtol=1e-5)
+
+
+def test_sgd_mom_update_matches_two_steps():
+    w, g, m = _r((5,), 0), _r((5,), 1), np.zeros(5, np.float32)
+    w1, m1 = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                               lr=0.1, momentum=0.9)
+    w2, m2 = nd.sgd_mom_update(w1, nd.array(g), m1, lr=0.1, momentum=0.9)
+    em1 = -0.1 * g
+    ew1 = w + em1
+    em2 = 0.9 * em1 - 0.1 * g
+    np.testing.assert_allclose(w2.asnumpy(), ew1 + em2, rtol=1e-5)
+
+
+def test_mp_sgd_update_keeps_fp32_master():
+    w32 = _r((6,), 2)
+    w16 = nd.cast(nd.array(w32), dtype="bfloat16")
+    g = nd.cast(nd.array(_r((6,), 3)), dtype="bfloat16")
+    w_out, w32_out = nd.mp_sgd_update(w16, g, nd.array(w32), lr=0.1)
+    assert str(w_out.dtype) == "bfloat16"
+    assert str(w32_out.dtype) == "float32"
+    np.testing.assert_allclose(
+        w32_out.asnumpy(),
+        w32 - 0.1 * np.asarray(g.astype("float32").asnumpy()), rtol=1e-2)
+
+
+def test_adam_update_oracle():
+    w, g = _r((4,), 0), _r((4,), 1)
+    m, v = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    w2, m2, v2 = nd.adam_update(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), lr=0.01)
+    em = 0.1 * g
+    ev = 0.001 * g * g
+    np.testing.assert_allclose(m2.asnumpy(), em, rtol=1e-5)
+    np.testing.assert_allclose(v2.asnumpy(), ev, rtol=1e-4)
+    np.testing.assert_allclose(
+        w2.asnumpy(), w - 0.01 * em / (np.sqrt(ev) + 1e-8), rtol=1e-5)
+
+
+def test_ftrl_signsgd_signum_rmsprop_run():
+    w, g = _r((4,), 0), _r((4,), 1) - 0.5
+    z = np.zeros(4, np.float32)
+    n = np.zeros(4, np.float32)
+    w2, z2, n2 = nd.ftrl_update(nd.array(w), nd.array(g), nd.array(z),
+                                nd.array(n), lr=0.1, lamda1=0.01)
+    assert np.isfinite(w2.asnumpy()).all()
+    out = nd.signsgd_update(nd.array(w), nd.array(g), lr=0.1).asnumpy()
+    np.testing.assert_allclose(out, w - 0.1 * np.sign(g), rtol=1e-6)
+    w3, m3 = nd.signum_update(nd.array(w), nd.array(g),
+                              nd.array(np.zeros(4, np.float32)), lr=0.1,
+                              momentum=0.9)
+    np.testing.assert_allclose(
+        w3.asnumpy(), w + 0.1 * np.sign(-(0.1) * g), rtol=1e-5)
+    w4, n4 = nd.rmsprop_update(nd.array(w), nd.array(g),
+                               nd.array(np.zeros(4, np.float32)), lr=0.01)
+    ev = 0.1 * g * g
+    np.testing.assert_allclose(
+        w4.asnumpy(), w - 0.01 * g / np.sqrt(ev + 1e-8), rtol=1e-4)
+
+
+def test_lamb_phases_compose():
+    w, g = _r((4,), 0) + 0.5, _r((4,), 1)
+    m = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    upd, m2, v2 = nd.lamb_update_phase1(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), t=1, wd=0.01)
+    r1 = nd.norm(nd.array(w))
+    r2 = nd.norm(upd)
+    w2 = nd.lamb_update_phase2(nd.array(w), upd, r1, r2, lr=0.01)
+    assert np.isfinite(w2.asnumpy()).all()
+    assert not np.allclose(w2.asnumpy(), w)
+
+
+def test_multi_sgd_update():
+    ws = [_r((3,), i) for i in range(2)]
+    gs = [_r((3,), 10 + i) for i in range(2)]
+    outs = nd.multi_sgd_update(
+        nd.array(ws[0]), nd.array(gs[0]), nd.array(ws[1]), nd.array(gs[1]),
+        lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(outs[1].asnumpy(), ws[1] - 0.2 * gs[1],
+                               rtol=1e-6)
+
+
+def test_amp_ops():
+    x = nd.array(_r((3,), 0))
+    assert str(nd.amp_cast(x, dtype="bfloat16").dtype) == "bfloat16"
+    a, b = nd.amp_multicast(nd.cast(x, dtype="bfloat16"), x)
+    assert str(a.dtype) == "float32" and str(b.dtype) == "float32"
+    assert float(nd.all_finite(x).asnumpy()[0]) == 1.0
+    bad = nd.array(np.array([1.0, np.inf], np.float32))
+    assert float(nd.all_finite(bad).asnumpy()[0]) == 0.0
+    assert float(nd.multi_all_finite(x, bad).asnumpy()[0]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# samplers
+# ---------------------------------------------------------------------------
+def test_sample_family_shapes_and_ranges():
+    low = nd.array(np.array([0.0, 10.0], np.float32))
+    high = nd.array(np.array([1.0, 20.0], np.float32))
+    s = nd.sample_uniform(low, high, shape=(500,)).asnumpy()
+    assert s.shape == (2, 500)
+    assert 0 <= s[0].min() and s[0].max() <= 1
+    assert 10 <= s[1].min() and s[1].max() <= 20
+
+    mu = nd.array(np.array([0.0, 100.0], np.float32))
+    sig = nd.array(np.array([1.0, 2.0], np.float32))
+    sn = nd.sample_normal(mu, sig, shape=(2000,)).asnumpy()
+    assert abs(sn[0].mean()) < 0.2 and abs(sn[1].mean() - 100) < 0.5
+
+    lam = nd.array(np.array([1.0, 50.0], np.float32))
+    sp = nd.sample_poisson(lam, shape=(1500,)).asnumpy()
+    assert abs(sp[0].mean() - 1.0) < 0.2 and abs(sp[1].mean() - 50) < 2.0
+
+
+# ---------------------------------------------------------------------------
+# image namespace
+# ---------------------------------------------------------------------------
+def test_image_namespace():
+    img = nd.array(np.random.RandomState(0).randint(
+        0, 255, (4, 6, 3)).astype(np.float32))
+    t = nd.image.to_tensor(img)
+    assert t.shape == (3, 4, 6)
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = nd.image.normalize(t, mean=(0.5, 0.5, 0.5),
+                              std=(0.5, 0.5, 0.5)).asnumpy()
+    np.testing.assert_allclose(norm, (t.asnumpy() - 0.5) / 0.5, rtol=1e-6)
+    r = nd.image.resize(img, size=(12, 8))
+    assert r.shape == (8, 12, 3)
+    c = nd.image.crop(img, x0=1, y0=2, width=3, height=2)
+    assert c.shape == (2, 3, 3)
+    f = nd.image.flip_left_right(img).asnumpy()
+    np.testing.assert_allclose(f, img.asnumpy()[:, ::-1])
+
+
+# ---------------------------------------------------------------------------
+# NN stragglers
+# ---------------------------------------------------------------------------
+def test_lrn_oracle():
+    x = _r((2, 5, 3, 3), 0)
+    out = nd.LRN(nd.array(x), nsize=3, alpha=1e-2, beta=0.75,
+                 knorm=2.0).asnumpy()
+    sq = np.pad(x ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = sq[:, 0:5] + sq[:, 1:6] + sq[:, 2:7]
+    want = x / (2.0 + 1e-2 / 3 * acc) ** 0.75
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_masked_softmax():
+    x = nd.array(_r((2, 4), 0))
+    mask = nd.array(np.array([[1, 1, 0, 1], [1, 0, 0, 1]], np.float32))
+    out = nd.masked_softmax(x, mask).asnumpy()
+    assert (out[mask.asnumpy() == 0] == 0).all()
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+    lout = nd.masked_log_softmax(x, mask).asnumpy()
+    np.testing.assert_allclose(np.exp(lout[0, [0, 1, 3]]).sum(), 1.0,
+                               rtol=1e-5)
+
+
+def test_add_n_identity_argmax_channel():
+    xs = [nd.array(_r((3, 2), i)) for i in range(3)]
+    np.testing.assert_allclose(
+        nd.add_n(*xs).asnumpy(),
+        sum(x.asnumpy() for x in xs), rtol=1e-6)
+    x = xs[0]
+    np.testing.assert_allclose(nd.identity(x).asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(
+        nd.argmax_channel(x).asnumpy(), x.asnumpy().argmax(axis=1))
+
+
+def test_im2col_col2im_roundtrip():
+    x = _r((1, 2, 5, 5), 0)
+    col = nd.im2col(nd.array(x), kernel=(3, 3), pad=(1, 1))
+    assert col.shape == (1, 2 * 9, 25)
+    # conv via im2col == lax conv
+    w = _r((4, 2, 3, 3), 1)
+    out_col = (w.reshape(4, -1) @ col.asnumpy()[0]).reshape(4, 5, 5)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=4).asnumpy()[0]
+    np.testing.assert_allclose(out_col, ref, rtol=1e-4, atol=1e-5)
+    back = nd.col2im(col, output_size=(5, 5), kernel=(3, 3),
+                     pad=(1, 1)).asnumpy()
+    # col2im sums each pixel once per window that contains it
+    ones_col = nd.im2col(nd.ones((1, 2, 5, 5)), kernel=(3, 3), pad=(1, 1))
+    counts = nd.col2im(ones_col, output_size=(5, 5), kernel=(3, 3),
+                       pad=(1, 1)).asnumpy()
+    np.testing.assert_allclose(back / counts, x, rtol=1e-5)
+
+
+def test_correlation_zero_displacement_is_mean_product():
+    a = _r((1, 4, 6, 6), 0)
+    b = _r((1, 4, 6, 6), 1)
+    out = nd.Correlation(nd.array(a), nd.array(b),
+                         max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 6, 6)
+    np.testing.assert_allclose(out[0, 4], (a * b).mean(axis=1)[0],
+                               rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    x = _r((1, 3, 6, 6), 0)
+    w = _r((4, 3, 3, 3), 1)
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        pad=(1, 1), num_filter=4).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=4).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_ctc_loss_matches_gluon():
+    from incubator_mxnet_tpu import gluon
+
+    rng = np.random.RandomState(0)
+    T, N, C, L = 8, 2, 5, 3
+    data = nd.array(rng.randn(T, N, C).astype(np.float32))
+    label = nd.array(np.array([[1, 2, -1], [3, 1, 2]], np.float32))
+    out = nd.ctc_loss(data, label).asnumpy()
+    ref = gluon.loss.CTCLoss(layout="TNC")(data, label).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+    assert (out > 0).all()
+
+
+def test_softmin():
+    x = nd.array(_r((2, 4), 0))
+    np.testing.assert_allclose(
+        nd.softmin(x).asnumpy(),
+        nd.softmax(nd.array(-x.asnumpy())).asnumpy(), rtol=1e-6)
+
+
+def test_crop_op():
+    x = nd.array(_r((1, 2, 6, 6), 0))
+    like = nd.zeros((1, 2, 4, 4))
+    out = nd.Crop(x, like, center_crop=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy()[:, :, 1:5, 1:5])
+    out2 = nd.Crop(x, h_w=(3, 3), offset=(2, 2))
+    np.testing.assert_allclose(out2.asnumpy(),
+                               x.asnumpy()[:, :, 2:5, 2:5])
