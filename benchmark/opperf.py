@@ -109,9 +109,221 @@ _NN = {
 
 ARGSPECS = {**_UNARY_1D, **_REDUCE, **_BINARY, **_SCALAR, **_MATMUL, **_NN}
 
+_SHAPE1 = dict.fromkeys("""
+cast clip flip transpose squeeze expand_dims tile repeat pad reshape
+slice slice_axis shape_array size_array diag broadcast_axis broadcast_to
+depth_to_space space_to_depth split stop_gradient_op identity softmin
+nan_to_num argmax_channel amp_cast all_finite shuffle moments
+masked_unused
+""".split(), ([("B", 1024)], {}))
+_SHAPE1.update({
+    "cast": ([("B", 1024)], {"dtype": "float32"}),
+    "clip": ([("B", 1024)], {"a_min": -1.0, "a_max": 1.0}),
+    "flip": ([("B", 32)], {"axis": 1}),
+    "transpose": ([(64, 32)], {}),
+    "squeeze": ([(64, 1, 32)], {}),
+    "expand_dims": ([("B", 32)], {"axis": 1}),
+    "tile": ([(8, 8)], {"reps": (2, 2)}),
+    "repeat": ([(8, 8)], {"repeats": 2}),
+    "pad": ([(8, 8)], {"pad_width": ((1, 1), (1, 1))}),
+    "reshape": ([(64, 32)], {"shape": (32, 64)}),
+    "slice": ([(64, 32)], {"begin": (0, 0), "end": (32, 16)}),
+    "slice_axis": ([(64, 32)], {"axis": 1, "begin": 0, "end": 16}),
+    "broadcast_axis": ([(64, 1)], {"axis": 1, "size": 32}),
+    "broadcast_to": ([(64, 1)], {"shape": (64, 32)}),
+    "depth_to_space": ([(2, 16, 8, 8)], {"block_size": 2}),
+    "space_to_depth": ([(2, 4, 16, 16)], {"block_size": 2}),
+    "split": ([(64, 32)], {"num_outputs": 2}),
+    "diag": ([(32, 32)], {}),
+    "moments": ([("B", 64)], {"axes": (1,)}),
+})
+_MORE = {
+    "where": ([("B", 64), ("B", 64), ("B", 64)], {}),
+    "pick": ("pick", {}),
+    "gather_nd": ("gather_nd", {}),
+    "scatter_nd": None,
+    "concat": ([("B", 64), ("B", 64)], {}),
+    "stack": ([("B", 64), ("B", 64)], {}),
+    "khatri_rao": ([(8, 16), (8, 16)], {}),
+    "boolean_mask_unused": None,
+    "sequence_mask": ([(16, "B", 8), ("B",)],
+                      {"use_sequence_length": True}),
+    "sequence_last": ([(16, "B", 8), ("B",)],
+                      {"use_sequence_length": True}),
+    "sequence_reverse": ([(16, "B", 8), ("B",)],
+                         {"use_sequence_length": True}),
+    "swapaxes_op": ([(16, 8, 4)], {"dim1": 0, "dim2": 2}),
+    "slice_like": ([(64, 32), (32, 16)], {}),
+    "GroupNorm": ([("B", 32, 8, 8), (32,), (32,)], {"num_groups": 4}),
+    "InstanceNorm": ([("B", 32, 8, 8), (32,), (32,)], {}),
+    "L2Normalization": ([("B", 64)], {}),
+    "LRN": ([("B", 16, 8, 8)], {"nsize": 3}),
+    "adaptive_avg_pool2d": ([("B", 8, 16, 16)], {"output_size": 4}),
+    "GridGenerator": ([(4, 6)], {"transform_type": "affine",
+                                 "target_shape": (8, 8)}),
+    "BilinearSampler": ("bilinear_sampler", {}),
+    "SpatialTransformer": ([(4, 3, 8, 8), (4, 6)],
+                           {"target_shape": (8, 8)}),
+    "ROIPooling": ("roi", {"pooled_size": (2, 2), "spatial_scale": 1.0}),
+    "ROIAlign": ("roi", {"pooled_size": (2, 2), "spatial_scale": 1.0}),
+    "Correlation": ([(2, 8, 12, 12), (2, 8, 12, 12)],
+                    {"max_displacement": 1}),
+    "DeformableConvolution": ("deform", {"kernel": (3, 3), "pad": (1, 1),
+                                         "num_filter": 8}),
+    "Crop": ([(2, 4, 16, 16)], {"h_w": (8, 8), "offset": (2, 2)}),
+    "im2col": ([(2, 8, 16, 16)], {"kernel": (3, 3), "pad": (1, 1)}),
+    "col2im": ("col2im", {"output_size": (16, 16), "kernel": (3, 3),
+                          "pad": (1, 1)}),
+    "CTCLoss": ("ctc", {}),
+    "SVMOutput": ("sce", {}),
+    "SoftmaxOutput": ("sce", {}),
+    "LinearRegressionOutput": ([("B", 16), ("B", 16)], {}),
+    "MAERegressionOutput": ([("B", 16), ("B", 16)], {}),
+    "LogisticRegressionOutput": ([("B", 16), ("B", 16)], {}),
+    "MakeLoss": ([("B", 16)], {}),
+    "masked_softmax": ([("B", 64), ("B", 64)], {}),
+    "masked_log_softmax": ([("B", 64), ("B", 64)], {}),
+    "add_n": ([("B", 64), ("B", 64), ("B", 64)], {}),
+    "amp_multicast": ([("B", 64), ("B", 64)], {}),
+    "multi_all_finite": ([("B", 64), ("B", 64)], {}),
+    "arange_like": ([("B", 16)], {}),
+    "broadcast_like": ([(1, 16), ("B", 16)], {}),
+    "reshape_like": ([("B", 16), ("B", 16)], {}),
+    "choose_element_0index": ("batch_take", {}),
+    "fill_element_0index": ("fill0", {}),
+    "index_copy": ("index_copy", {}),
+    "index_array": ([(8, 8)], {}),
+    "sparse_retain_rows": ("index_copy_data", {}),
+    "ravel_multi_index": ("ravel", {"shape": (16, 16)}),
+    "unravel_index": ("unravel", {"shape": (16, 16)}),
+    "interleaved_matmul_selfatt_qk": ([(16, 4, 3 * 4 * 16)], {"heads": 4}),
+    "interleaved_matmul_encdec_qk": ([(16, 4, 64), (16, 4, 128)],
+                                     {"heads": 4}),
+    "random_uniform": ([], {"shape": (1024,)}),
+    "random_normal": ([], {"shape": (1024,)}),
+    "random_gamma": ([], {"shape": (1024,)}),
+    "random_exponential": ([], {"shape": (1024,)}),
+    "random_poisson": ([], {"shape": (1024,)}),
+    "random_randint": ([], {"low": 0, "high": 10, "shape": (1024,)}),
+    "random_bernoulli": ([], {"shape": (1024,)}),
+    "sample_uniform": ([(8,), (8,)], {"shape": (64,)}),
+    "sample_normal": ([(8,), (8,)], {"shape": (64,)}),
+    "sample_gamma": ([(8,), (8,)], {"shape": (64,)}),
+    "sample_exponential": ([(8,)], {"shape": (64,)}),
+    "sample_poisson": ([(8,)], {"shape": (64,)}),
+    "sample_negative_binomial": ([(8,), (8,)], {"shape": (64,)}),
+    "sample_multinomial": ("multinomial", {}),
+    "image_to_tensor": ([(32, 32, 3)], {}),
+    "image_normalize": ([(3, 32, 32)], {"mean": (0.5,), "std": (0.5,)}),
+    "image_resize": ([(32, 32, 3)], {"size": (16, 16)}),
+    "image_crop": ([(32, 32, 3)], {"x0": 2, "y0": 2, "width": 16,
+                                   "height": 16}),
+    "image_flip_left_right": ([(32, 32, 3)], {}),
+    "image_flip_top_bottom": ([(32, 32, 3)], {}),
+    "image_random_flip_left_right": ([(32, 32, 3)], {}),
+    "sgd_update": ([("B", 64), ("B", 64)], {"lr": 0.1}),
+    "sgd_mom_update": ([("B", 64), ("B", 64), ("B", 64)], {"lr": 0.1}),
+    "mp_sgd_update": ([("B", 64), ("B", 64), ("B", 64)], {"lr": 0.1}),
+    "mp_sgd_mom_update": ([("B", 64)] * 4, {"lr": 0.1}),
+    "nag_mom_update": ([("B", 64)] * 3, {"lr": 0.1, "momentum": 0.9}),
+    "adam_update": ([("B", 64)] * 4, {"lr": 0.01}),
+    "adamw_update": ([("B", 64)] * 4, {"lr": 0.01}),
+    "rmsprop_update": ([("B", 64)] * 3, {"lr": 0.01}),
+    "rmspropalex_update": ([("B", 64)] * 5, {"lr": 0.01}),
+    "ftrl_update": ([("B", 64)] * 4, {"lr": 0.1}),
+    "signsgd_update": ([("B", 64)] * 2, {"lr": 0.1}),
+    "signum_update": ([("B", 64)] * 3, {"lr": 0.1, "momentum": 0.9}),
+    "lamb_update_phase1": ([("B", 64)] * 4, {"t": 1}),
+    "multibox_target": ("mbt", {}),
+    "multibox_detection": ("mbd", {"nms_topk": 20}),
+    "box_encode": ("box_encode", {}),
+    "box_decode": ("box_decode", {}),
+    "bipartite_matching": ([(4, 16, 8)], {}),
+    "linalg_gemm": ([(8, 32, 32)] * 3, {}),
+    "linalg_extractdiag": ([("B", 32, 32)], {}),
+    "linalg_makediag": ([("B", 32)], {}),
+    "linalg_extracttrian": ([("B", 16, 16)], {}),
+}
+_MORE = {k: v for k, v in _MORE.items() if v is not None}
+ARGSPECS.update({k: v for k, v in _SHAPE1.items()
+                 if k != "masked_unused"})
+ARGSPECS.update(_MORE)
+
+
 
 def _make_inputs(nd, spec, batch):
     rng = np.random.RandomState(0)
+
+    if spec == "pick":
+        return [nd.array(rng.rand(batch, 16).astype(np.float32)),
+                nd.array(rng.randint(0, 16, (batch,)).astype(np.float32))]
+    if spec == "gather_nd":
+        return [nd.array(rng.rand(16, 16).astype(np.float32)),
+                nd.array(rng.randint(0, 16, (2, batch)
+                                     ).astype(np.float32))]
+    if spec == "bilinear_sampler":
+        grid = rng.rand(2, 2, 8, 8).astype(np.float32) * 2 - 1
+        return [nd.array(rng.rand(2, 3, 8, 8).astype(np.float32)),
+                nd.array(grid)]
+    if spec == "roi":
+        rois = np.array([[0, 1, 1, 6, 6], [1, 0, 0, 4, 4]], np.float32)
+        return [nd.array(rng.rand(2, 4, 8, 8).astype(np.float32)),
+                nd.array(rois)]
+    if spec == "deform":
+        return [nd.array(rng.rand(2, 4, 8, 8).astype(np.float32)),
+                nd.array(np.zeros((2, 18, 8, 8), np.float32)),
+                nd.array(rng.rand(8, 4, 3, 3).astype(np.float32))]
+    if spec == "col2im":
+        return [nd.array(rng.rand(2, 8 * 9, 256).astype(np.float32))]
+    if spec == "ctc":
+        return [nd.array(rng.randn(16, batch, 8).astype(np.float32)),
+                nd.array(rng.randint(1, 8, (batch, 4)
+                                     ).astype(np.float32))]
+    if spec == "fill0":
+        return [nd.array(rng.rand(batch, 16).astype(np.float32)),
+                nd.array(rng.rand(batch).astype(np.float32)),
+                nd.array(rng.randint(0, 16, (batch,)).astype(np.float32))]
+    if spec == "index_copy":
+        return [nd.array(rng.rand(64, 8).astype(np.float32)),
+                nd.array(np.arange(4, dtype=np.float32)),
+                nd.array(rng.rand(4, 8).astype(np.float32))]
+    if spec == "index_copy_data":
+        return [nd.array(rng.rand(64, 8).astype(np.float32)),
+                nd.array(np.arange(4, dtype=np.float32))]
+    if spec == "ravel":
+        return [nd.array(rng.randint(0, 16, (2, batch)
+                                     ).astype(np.float32))]
+    if spec == "unravel":
+        return [nd.array(rng.randint(0, 255, (batch,)
+                                     ).astype(np.float32))]
+    if spec == "multinomial":
+        p = rng.rand(batch, 8).astype(np.float32)
+        return [nd.array(p / p.sum(-1, keepdims=True))]
+    if spec == "mbt":
+        anchors = rng.rand(1, 32, 4).astype(np.float32)
+        anchors[..., 2:] = anchors[..., :2] + 0.2
+        labels = np.full((2, 3, 5), -1, np.float32)
+        labels[:, 0] = [0, .1, .1, .4, .4]
+        return [nd.array(anchors), nd.array(labels),
+                nd.array(np.zeros((2, 4, 32), np.float32))]
+    if spec == "mbd":
+        anchors = rng.rand(1, 32, 4).astype(np.float32)
+        anchors[..., 2:] = anchors[..., :2] + 0.2
+        probs = rng.rand(2, 4, 32).astype(np.float32)
+        return [nd.array(probs / probs.sum(1, keepdims=True)),
+                nd.array(rng.rand(2, 128).astype(np.float32) * 0.1),
+                nd.array(anchors)]
+    if spec == "box_encode":
+        boxes = rng.rand(2, 8, 4).astype(np.float32)
+        boxes[..., 2:] = boxes[..., :2] + 0.2
+        return [nd.array(np.ones((2, 8), np.float32)),
+                nd.array(np.zeros((2, 8), np.float32)),
+                nd.array(boxes), nd.array(boxes[:, :4])]
+    if spec == "box_decode":
+        anchors = rng.rand(1, 8, 4).astype(np.float32)
+        anchors[..., 2:] = anchors[..., :2] + 0.2
+        return [nd.array(rng.rand(2, 8, 4).astype(np.float32) * 0.1),
+                nd.array(anchors)]
     if spec == "spd":
         a = rng.rand(8, 64, 64).astype(np.float32)
         return [nd.array(a @ a.transpose(0, 2, 1)

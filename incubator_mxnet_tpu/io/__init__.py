@@ -481,24 +481,44 @@ class ImageRecordIter(DataIter):
 
         n = len(raw_imgs)
         x = np.zeros((n, h, w, 3), np.float32)
-        if self._native is not None:
-            from ..native import decode_jpeg_batch, jpeg_dims
 
-            dims = [jpeg_dims(r) for r in raw_imgs]
-            ch = max(max(d[0] for d in dims), h)
-            cw = max(max(d[1] for d in dims), w)
-            canvas, sizes = decode_jpeg_batch(raw_imgs, ch, cw,
-                                              self._threads)
-            for i, (gh, gw) in enumerate(sizes):
-                x[i] = self._fit(canvas[i, :gh, :gw])
-        else:
+        def _pil_decode(rb):
             import io as _io
 
             from PIL import Image
 
+            return np.asarray(Image.open(_io.BytesIO(rb)).convert("RGB"))
+
+        if self._native is not None:
+            from ..native import (decode_jpeg, decode_jpeg_batch,
+                                  jpeg_dims)
+
+            # only JPEG payloads (FFD8 magic) go native; PNG-packed
+            # records fall back to PIL per record
+            is_jpg = [rb[:2] == b"\xff\xd8" for rb in raw_imgs]
+            dims = [jpeg_dims(rb) if j else None
+                    for rb, j in zip(raw_imgs, is_jpg)]
+            jdims = [d for d in dims if d is not None]
+            if jdims and all(d == jdims[0] for d in jdims) and all(is_jpg):
+                # uniform-size all-jpeg batch: one threaded native call
+                gh, gw = jdims[0]
+                canvas, _ = decode_jpeg_batch(raw_imgs, gh, gw,
+                                              self._threads)
+                for i in range(n):
+                    x[i] = self._fit(canvas[i])
+            else:
+                # mixed sizes/formats: per-image exact-size buffers (the
+                # reference also decodes per image)
+                for i, rb in enumerate(raw_imgs):
+                    if is_jpg[i]:
+                        ih, iw = dims[i]
+                        img, _ = decode_jpeg(rb, ih, iw)
+                    else:
+                        img = _pil_decode(rb)
+                    x[i] = self._fit(img)
+        else:
             for i, rb in enumerate(raw_imgs):
-                im = np.asarray(Image.open(_io.BytesIO(rb)).convert("RGB"))
-                x[i] = self._fit(im)
+                x[i] = self._fit(_pil_decode(rb))
         if self._rand_mirror:
             flip = self._rng.rand(n) < 0.5
             x[flip] = x[flip, :, ::-1]

@@ -24,9 +24,17 @@ _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
 def _build() -> bool:
     if not os.path.isdir(_SRC_DIR):
         return False
+    # serialize concurrent builders (multi-process launch.py workers):
+    # one holds the flock and runs make; the rest block, then see the .so
+    lock_path = _SO + ".lock"
     try:
-        subprocess.run(["make", "-C", _SRC_DIR], check=True,
-                       capture_output=True, timeout=240)
+        import fcntl
+
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if not os.path.exists(_SO):
+                subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                               capture_output=True, timeout=240)
         return os.path.exists(_SO)
     except Exception:
         return False
@@ -126,6 +134,26 @@ def jpeg_dims(record: bytes):
     if rc != 0:
         raise IOError("corrupt jpeg record")
     return h.value, w.value
+
+
+def decode_jpeg(record: bytes, h: int, w: int):
+    """Decode ONE jpeg into an exact (h, w, 3) uint8 buffer."""
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        raise RuntimeError("native IO library unavailable")
+    out = np.zeros((h, w, 3), np.uint8)
+    buf = np.frombuffer(record, np.uint8)
+    gh = ctypes.c_int()
+    gw = ctypes.c_int()
+    rc = l.mxio_decode_jpeg(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(record),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w,
+        ctypes.byref(gh), ctypes.byref(gw))
+    if rc != 0:
+        raise IOError("jpeg decode failed")
+    return out, (gh.value, gw.value)
 
 
 def decode_jpeg_batch(records, h: int, w: int, threads: int = 4):

@@ -408,9 +408,11 @@ def _wrap_update(name, narr, n_state):
         res = invoke(opdef.fn, arrays, kwargs, name=opdef.name,
                      differentiable=False)
         outs = list(res) if isinstance(res, tuple) else [res]
-        tgt = out if out is not None else arrays[0]
-        tgt._set_data(outs[0]._data)
-        # trailing states: last n_state array args, in op output order
+        if out is not None:
+            # reference out= semantics; without out the weight arg is
+            # left untouched and the new value is only returned
+            out._set_data(outs[0]._data)
+        # optimizer states are inputs the reference op mutates in place
         for o, a in zip(outs[1:], arrays[narr - n_state:]):
             a._set_data(o._data)
         return res
@@ -509,3 +511,30 @@ _sys.modules[image.__name__] = image
 
 contrib.DeformableConvolution = DeformableConvolution
 contrib.ctc_loss = ctc_loss
+
+
+# final straggler surface: fused attention, shape-derived, Custom
+for _n, _k in [("interleaved_matmul_selfatt_qk", 1),
+               ("interleaved_matmul_selfatt_valatt", 2),
+               ("interleaved_matmul_encdec_qk", 2),
+               ("interleaved_matmul_encdec_valatt", 2),
+               ("arange_like", 1), ("broadcast_like", 2),
+               ("reshape_like", 2), ("nan_to_num", 1),
+               ("choose_element_0index", 2), ("fill_element_0index", 3),
+               ("index_copy", 3), ("SVMOutput", 2),
+               ("sparse_retain_rows", 2)]:
+    setattr(_this, _n, _wrap(_n, _k))
+
+Pad = _wrap("pad", 1)
+contrib.arange_like = _this.arange_like
+contrib.index_copy = _this.index_copy
+
+
+def Custom(*data, op_type=None, **kwargs):
+    """Invoke a registered custom python op (reference ``mx.nd.Custom``
+    over mx.operator.register)."""
+    from ..operator import invoke_custom
+
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    return invoke_custom(op_type, list(data), kwargs)

@@ -394,4 +394,169 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
                     ).astype(jnp.float32)
     else:
         labelpad = (lab < 0).astype(jnp.float32)
-    return optax.ctc_loss(p, logitpad, lpad, labelpad)
+    blank_id = 0 if blank_label == "first" else data.shape[-1] - 1
+    return optax.ctc_loss(p, logitpad, lpad, labelpad, blank_id=blank_id)
+
+
+# ---------------------------------------------------------------------------
+# fused transformer matmuls (reference interleaved_matmul_*.cc, the 1.x
+# fused self-attention ops behind GluonNLP's fast BERT)
+# ---------------------------------------------------------------------------
+@register("interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(T, N, 3*H*D) interleaved qkv -> attention scores (N*H, T, T)."""
+    t, n, hd3 = queries_keys_values.shape
+    d = hd3 // (3 * heads)
+    x = queries_keys_values.reshape(t, n, heads, 3, d)
+    q = x[:, :, :, 0]                                    # (T, N, H, D)
+    k = x[:, :, :, 1]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(n * heads, t, d)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(n * heads, t, d)
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    return jnp.einsum("bqd,bkd->bqk", q * scale, k)
+
+
+@register("interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    """(T, N, 3*H*D) values + (N*H, T, T) attention -> (T, N, H*D)."""
+    t, n, hd3 = queries_keys_values.shape
+    d = hd3 // (3 * heads)
+    v = queries_keys_values.reshape(t, n, heads, 3, d)[:, :, :, 2]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(n * heads, t, d)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)       # (N*H, T, D)
+    out = out.reshape(n, heads, t, d)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(t, n, heads * d)
+
+
+@register("interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """q (Tq, N, H*D) + interleaved kv (Tk, N, 2*H*D) -> (N*H, Tq, Tk)."""
+    tq, n, hd = queries.shape
+    d = hd // heads
+    tk = keys_values.shape[0]
+    q = jnp.transpose(queries.reshape(tq, n, heads, d),
+                      (1, 2, 0, 3)).reshape(n * heads, tq, d)
+    k = keys_values.reshape(tk, n, heads, 2, d)[:, :, :, 0]
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(n * heads, tk, d)
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    return jnp.einsum("bqd,bkd->bqk", q * scale, k)
+
+
+@register("interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    tk, n, hd2 = keys_values.shape
+    d = hd2 // (2 * heads)
+    v = keys_values.reshape(tk, n, heads, 2, d)[:, :, :, 1]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(n * heads, tk, d)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)
+    tq = attention.shape[1]
+    out = out.reshape(n, heads, tq, d)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(tq, n, heads * d)
+
+
+# ---------------------------------------------------------------------------
+# shape-derived / indexing stragglers
+# ---------------------------------------------------------------------------
+@register("arange_like", differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference contrib arange_like: arange sized from data's shape."""
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        # reference: output has data's shape; values are an arange over
+        # n // repeat steps, each repeated `repeat` times
+        base = start + step * jnp.arange(n // repeat, dtype=jnp.float32)
+        return jnp.repeat(base, repeat).reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n // repeat, dtype=jnp.float32
+                                     ).repeat(repeat)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("nan_to_num")
+def nan_to_num(data, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(data, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("choose_element_0index", differentiable=False)
+def choose_element_0index(data, index):
+    """Reference legacy: out[i] = data[i, index[i]] (batch_take alias)."""
+    idx = index.astype(jnp.int32).reshape(-1, 1)
+    return jnp.take_along_axis(data, idx, axis=1)[:, 0]
+
+
+@register("fill_element_0index", differentiable=False)
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with lhs[i, rhs[i]] = mhs[i] (reference legacy op)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("index_copy", differentiable=False)
+def index_copy(old, index_vector, new_tensor):
+    """Reference contrib index_copy: rows of old replaced by new rows."""
+    idx = index_vector.astype(jnp.int32)
+    return old.at[idx].set(new_tensor)
+
+
+@register("sparse_retain_rows", differentiable=False)
+def sparse_retain_rows(data, indices):
+    """Dense-view of sparse retain: zero all rows not in indices
+    (the op surface for sparse.retain on the dense fallback)."""
+    n = data.shape[0]
+    mask = jnp.zeros((n,), bool).at[indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _svm_core(data, label, margin, reg_coef):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, res, g):
+    del g
+    data, label = res
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=data.dtype)
+    # hinge: grad -1 on true class where violated, +1 on violators
+    scores_true = jnp.take_along_axis(data, lab[..., None], -1)
+    violate = (data - scores_true + margin > 0) & (onehot == 0)
+    grad = violate.astype(data.dtype)
+    grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
+    import numpy as _onp
+
+    lab_ct = _onp.zeros(label.shape, dtype=jax.dtypes.float0) \
+        if label.dtype.kind != "f" else jnp.zeros_like(label)
+    return grad * reg_coef, lab_ct
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """SVM output layer (reference src/operator/svm_output.cc): forward
+    identity, backward multi-class hinge gradient."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient))

@@ -168,3 +168,47 @@ def test_ctc_loss_with_lengths():
     dl = mx.nd.array(np.array([6, 4], np.float32))
     out = nd.ctc_loss(data, label, data_lengths=dl).asnumpy()
     assert out.shape == (2,) and np.isfinite(out).all()
+
+
+def test_image_record_iter_png_records(tmp_path):
+    """PNG-packed .rec files must iterate identically with or without
+    the native library (native path falls back per record)."""
+    from PIL import Image
+
+    path = str(tmp_path / "png.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        arr = rng.randint(0, 255, (9, 11, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    arr, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 9, 11),
+                               batch_size=4)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 9, 11)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), [0, 1, 2, 3])
+
+
+def test_optimizer_update_without_out_leaves_weight():
+    from incubator_mxnet_tpu import ndarray as nd
+
+    w = mx.nd.array(np.ones(4, np.float32))
+    g = mx.nd.array(np.full(4, 0.5, np.float32))
+    w2 = nd.sgd_update(w, g, lr=0.1)
+    np.testing.assert_allclose(w.asnumpy(), 1.0)      # untouched
+    np.testing.assert_allclose(w2.asnumpy(), 0.95, rtol=1e-6)
+
+
+def test_arange_like_repeat_and_ctc_blank_last():
+    from incubator_mxnet_tpu import ndarray as nd
+
+    out = nd.arange_like(mx.nd.zeros((2, 3)), repeat=2).asnumpy()
+    np.testing.assert_allclose(out, [[0, 0, 1], [1, 2, 2]])
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(8, 2, 5).astype(np.float32))
+    label = mx.nd.array(np.array([[1, 2, -1], [3, 1, 2]], np.float32))
+    first = nd.ctc_loss(data, label).asnumpy()
+    last = nd.ctc_loss(data, label, blank_label="last").asnumpy()
+    assert not np.allclose(first, last)
