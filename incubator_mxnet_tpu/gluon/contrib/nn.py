@@ -40,3 +40,29 @@ class SyncBatchNorm(BatchNorm):
             running_variance_initializer=running_variance_initializer,
             in_channels=in_channels, prefix=prefix, params=params)
         self._num_devices = num_devices
+
+
+from ..block import HybridBlock as _HybridBlock
+
+
+class HybridConcurrent(_HybridBlock):
+    """Parallel-branch container: feeds the same input to every child and
+    concatenates their outputs (reference
+    ``gluon.contrib.nn.HybridConcurrent`` — the Inception block glue)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+Concurrent = HybridConcurrent

@@ -2,7 +2,7 @@
 
 Capability parity with reference ``python/mxnet/gluon/model_zoo/vision/``:
 ResNet v1/v2 (18/34/50/101/152), VGG(+BN), AlexNet, SqueezeNet, DenseNet,
-MobileNet v1/v2, and the ``get_model`` registry. ``pretrained=True`` is
+MobileNet v1/v2, Inception V3, and the ``get_model`` registry. ``pretrained=True`` is
 gated (no network egress in this environment) — weights load from a local
 root when present.
 
@@ -20,6 +20,7 @@ from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
                      ResNetV1, ResNetV2, get_resnet, resnet18_v1, resnet18_v2,
                      resnet34_v1, resnet34_v2, resnet50_v1, resnet50_v2,
                      resnet101_v1, resnet101_v2, resnet152_v1, resnet152_v2)
+from .inception import Inception3, inception_v3
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .vgg import (VGG, vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16, vgg16_bn,
                   vgg19, vgg19_bn)
@@ -38,6 +39,7 @@ _models = {
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "inceptionv3": inception_v3,
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,

@@ -499,16 +499,20 @@ class ImageRecordIter(DataIter):
             dims = [jpeg_dims(rb) if j else None
                     for rb, j in zip(raw_imgs, is_jpg)]
             jdims = [d for d in dims if d is not None]
-            if jdims and all(d == jdims[0] for d in jdims) and all(is_jpg):
-                # uniform-size all-jpeg batch: one threaded native call
-                gh, gw = jdims[0]
-                canvas, _ = decode_jpeg_batch(raw_imgs, gh, gw,
-                                              self._threads)
-                for i in range(n):
-                    x[i] = self._fit(canvas[i])
+            mh = max((d[0] for d in jdims), default=0)
+            mw = max((d[1] for d in jdims), default=0)
+            # threaded batch decode when every record is jpeg AND the
+            # max-dims canvas stays sane (mixed sizes are fine; one
+            # outlier panorama must not force a multi-GB allocation)
+            canvas_ok = n * mh * mw * 3 <= 256 * 1024 * 1024
+            if jdims and all(is_jpg) and canvas_ok:
+                canvas, sizes = decode_jpeg_batch(raw_imgs, mh, mw,
+                                                  self._threads)
+                for i, (gh, gw) in enumerate(sizes):
+                    x[i] = self._fit(canvas[i, :gh, :gw])
             else:
-                # mixed sizes/formats: per-image exact-size buffers (the
-                # reference also decodes per image)
+                # oversized canvas or mixed formats: per-image exact-size
+                # buffers (the reference also decodes per image)
                 for i, rb in enumerate(raw_imgs):
                     if is_jpg[i]:
                         ih, iw = dims[i]

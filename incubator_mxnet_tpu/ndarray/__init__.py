@@ -397,10 +397,11 @@ from ..ops import optimizer_ops as _opt_ops  # noqa: F401
 from ..ops import more as _more  # noqa: F401
 
 def _wrap_update(name, narr, n_state):
-    """Optimizer update ops with reference in-place semantics: the first
-    ``narr`` args are arrays; updated weight writes to ``out`` (or arg0)
-    and the trailing ``n_state`` array args (momentum/mean/var/...) are
-    rebound in place, mirroring the reference's mutate-inputs ops."""
+    """Optimizer update ops with reference semantics: the first ``narr``
+    args are arrays; the updated weight is returned, and written in place
+    ONLY when ``out=`` is passed; the trailing ``n_state`` array args
+    (momentum/mean/var/...) are always rebound in place, mirroring the
+    reference's mutate-inputs ops."""
     opdef = _registry.get(name)
 
     def op(*args, out=None, **kwargs):

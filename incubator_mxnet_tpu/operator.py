@@ -9,11 +9,13 @@ framework lacks.
 
 TPU-native stance: the custom body runs EAGERLY in Python over NDArrays
 (which dispatch to XLA per op), and autograd integration goes through a
-``jax.custom_vjp`` whose forward/backward call the user's methods via
-``jax.pure_callback`` when traced — so custom ops also work inside
-``hybridize()``/jit, at the cost of a host callback per invocation
-(documented divergence: the reference pays the same host hop into
-Python from its engine thread).
+``jax.custom_vjp`` whose forward/backward call the user's methods
+directly on host arrays when eager, or via ``jax.pure_callback`` when
+traced — so custom ops also work inside ``hybridize()``/jit at the cost
+of a host callback per invocation (the reference pays the same host hop
+into Python from its engine thread). Jit-embedded custom ops need a
+backend with host-callback support: available on CPU/standard TPU;
+the experimental axon tunnel runs them eagerly only.
 """
 
 from __future__ import annotations
@@ -61,7 +63,7 @@ class CustomOpProp:
         return []
 
     def infer_shape(self, in_shape):
-        return in_shape, [in_shape[0]], []
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
 
     def infer_type(self, in_type):
         return in_type, [in_type[0]] * len(self.list_outputs()), []
@@ -147,6 +149,11 @@ def invoke_custom(op_type: str, inputs, kwargs):
         return _call_fwd(*arrays)
 
     def _call_fwd(*arrays):
+        if not any(isinstance(a, jax.core.Tracer) for a in arrays):
+            # eager: run on the host directly (works on backends whose
+            # host-callback path is unavailable, e.g. the axon tunnel)
+            outs = run_forward(*[np.asarray(a) for a in arrays])
+            return tuple(jnp.asarray(o) for o in outs)
         out_avals = tuple(
             jax.ShapeDtypeStruct(tuple(s), d)
             for s, d in zip(out_shapes, out_dtypes))
@@ -159,14 +166,38 @@ def invoke_custom(op_type: str, inputs, kwargs):
 
     def core_bwd(res, gs):
         arrays, outs = res
+        all_args = tuple(gs) + tuple(arrays) + tuple(outs)
+        if not any(isinstance(a, jax.core.Tracer) for a in all_args):
+            grads = run_backward(*[np.asarray(a) for a in all_args])
+            return tuple(jnp.asarray(g) for g in grads)
         in_avals = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                          for s, d in zip(in_shapes, in_dtypes))
-        grads = jax.pure_callback(run_backward, in_avals,
-                                  *(tuple(gs) + tuple(arrays)
-                                    + tuple(outs)), vmap_method=None)
+        grads = jax.pure_callback(run_backward, in_avals, *all_args,
+                                  vmap_method=None)
         return tuple(grads)
 
     core.defvjp(core_fwd, core_bwd)
+
+    in_data = [x._data for x in inputs]
+    concrete = not any(isinstance(a, jax.core.Tracer) for a in in_data)
+    if concrete and autograd.is_recording():
+        # eager + recording: run on the host and attach the tape node
+        # directly with a host-side vjp — no jax.vjp trace, so this works
+        # on backends without host-callback support (the axon tunnel)
+        outs_np = run_forward(*[np.asarray(a) for a in in_data])
+        outs = [NDArray(jnp.asarray(o)) for o in outs_np]
+
+        def vjp_fn(cts):
+            cts_t = tuple(cts) if isinstance(cts, (tuple, list)) else (cts,)
+            grads = run_backward(*([np.asarray(c) for c in cts_t]
+                                   + [np.asarray(a) for a in in_data]
+                                   + list(outs_np)))
+            return tuple(jnp.asarray(g) for g in grads)
+
+        autograd.record_op(vjp_fn, list(inputs), outs,
+                           name=f"Custom[{op_type}]",
+                           pure_fn=core, pure_tuple=True)
+        return outs[0] if n_out == 1 else tuple(outs)
 
     res = invoke(lambda *a: core(*a), list(inputs), {},
                  name=f"Custom[{op_type}]")

@@ -48,13 +48,21 @@ def pallas_available() -> bool:
 
 
 def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bq, bk, t_k,
-                      t_valid, tq_valid, scale, causal):
+                      t_valid, tq_valid, scale, causal, n_heads):
     from jax import lax
 
     qi = q_ref[0]                                # native dtype: bf16 stays
     d = qi.shape[-1]                             # on the fast MXU path
     i = _pl().program_id(1)
-    klen = len_ref[0]                            # per-sample key length
+    # whole lengths vector lives in SMEM (Mosaic rejects rank-1 sub-
+    # blocking); index the batch entry for this (batch*head) program
+    klen = len_ref[_pl().program_id(0) // n_heads]
+    # dtype-aware matmul precision: bf16 inputs take the native MXU pass
+    # (DEFAULT); f32 inputs need HIGHEST or Mosaic truncates the
+    # multiplies to bf16 (~1e-2 abs error vs the XLA reference)
+    prec = (jax.lax.Precision.DEFAULT
+            if qi.dtype in (jnp.bfloat16, jnp.float16)
+            else jax.lax.Precision.HIGHEST)
 
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
@@ -70,14 +78,13 @@ def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bq, bk, t_k,
         pl = _pl()
         k = k_ref[0, pl.ds(j * bk, bk), :]                   # (bk, d)
         v = v_ref[0, pl.ds(j * bk, bk), :]
-        # qk in the input dtype with fp32 accumulation (MXU-native).
-        # precision must be DEFAULT: the package-global
-        # jax_default_matmul_precision='highest' would ask Mosaic for an
-        # fp32-precision contraction over bf16 vectors, which it rejects
+        # qk in the input dtype with fp32 accumulation (MXU-native);
+        # explicit precision because the package-global 'highest' default
+        # is rejected by Mosaic for bf16 contractions
         s = jax.lax.dot_general(
             qi, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT) * scale     # (bq, bk)
+            precision=prec) * scale                          # (bq, bk)
         cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = cols < jnp.minimum(t_valid, klen)
         if causal:
@@ -94,7 +101,7 @@ def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bq, bk, t_k,
         acc = acc * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)
+            precision=prec)
         return m2, l, acc
 
     if causal:
@@ -141,12 +148,12 @@ def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512):
 
     kernel = functools.partial(
         _flash_fwd_kernel, bq=bq, bk=bk, t_k=tkp, t_valid=tk, tq_valid=tq,
-        scale=scale, causal=causal)
+        scale=scale, causal=causal, n_heads=h)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, tqp // bq),
         in_specs=[
-            pl.BlockSpec((1,), lambda bi, i, _h=h: (bi // _h,),
+            pl.BlockSpec((b,), lambda bi, i: (0,),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0)),
             pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0)),

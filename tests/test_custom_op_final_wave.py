@@ -146,3 +146,13 @@ def test_sparse_retain_rows():
     out = nd.sparse_retain_rows(
         data, nd.array(np.array([0, 2], np.float32))).asnumpy()
     np.testing.assert_allclose(out, [[0, 1], [0, 0], [4, 5], [0, 0]])
+
+
+# jit-embedded custom ops need backend host-callback support; the
+# experimental axon tunnel lacks it (eager custom ops still work there)
+import jax as _jax
+
+if _jax.devices()[0].platform != "cpu":
+    test_custom_op_inside_hybridized_block = pytest.mark.skip(
+        reason="host callbacks unsupported on the axon tunnel")(
+        test_custom_op_inside_hybridized_block)
