@@ -10,12 +10,14 @@ parameters carry ``PartitionSpec`` rules, and overlap comes from XLA's async
 collectives and latency-hiding scheduler.
 
 Axes convention (scaling-book style): ``data`` (DP), ``model`` (TP),
-``seq`` (SP/CP), ``expert`` (EP, reserved), ``pipe`` (PP, reserved).
+``seq`` (SP/CP), ``expert`` (EP), ``pipe`` (PP — GPipe microbatch
+schedule, see :mod:`.pipeline`).
 """
 
-from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, current_mesh, make_mesh,
-                   mesh_scope)
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   current_mesh, make_mesh, mesh_scope)
 from .collectives import (allreduce_across_processes, allreduce_arrays,
                           init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
+from .pipeline import PipelineTrainer, pipeline_apply, stack_stage_params
 from .checkpoint import restore_sharded, save_sharded

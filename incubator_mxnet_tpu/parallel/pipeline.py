@@ -1,0 +1,342 @@
+"""Pipeline parallelism (PP) over the ``pipe`` mesh axis.
+
+SURVEY.md §2.4 PP row: the reference has no pipeline parallelism — its
+closest mechanism is manual ``group2ctx`` device placement in Module bind
+(``src/executor/graph_executor.cc`` PlaceDevice pass), which splits a graph
+across devices but executes stages serially with host-mediated copies.
+This module is the TPU-native first-class replacement: a GPipe-style
+microbatch schedule expressed as ONE XLA computation.
+
+Design (scaling-book "pipelining = collective permute" recipe):
+
+- Stage parameters are **stacked on a leading stage axis** and sharded over
+  the ``pipe`` mesh axis, so each device holds exactly its stage's weights.
+- Inside ``shard_map``, a ``lax.scan`` runs ``M + S - 1`` ticks; each tick
+  every device applies its stage to the activation it holds, then the
+  activations rotate one hop around the ring with ``lax.ppermute`` —
+  compiling to the TPU's CollectivePermute over ICI neighbours.
+- The whole schedule is reverse-mode differentiable (``scan`` and
+  ``ppermute`` both have transposes), so ``jax.grad`` of a pipelined
+  forward IS the mirrored backward pipeline — no hand-written 1F1B
+  machinery, XLA schedules the overlap.
+
+Constraints (the canonical pipeline contract): every stage maps activations
+of one shape/dtype to the same shape/dtype (transformer body layers).
+Prologue (embedding) and epilogue (head) run outside the pipelined region,
+replicated. Stages must be free of cross-step mutable state (BatchNorm
+running stats); LayerNorm is fine.
+
+Composes with DP: build the mesh with both axes —
+``make_mesh({'pipe': 4, 'data': 2})`` — and the microbatch *batch* dim is
+additionally sharded over ``data``.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import autograd
+from .. import random as _random
+from ..gluon.block import _Trace
+from ..gluon.parameter import _trace
+from ..ndarray import NDArray
+from .mesh import DATA_AXIS, PIPE_AXIS, make_mesh
+from .spmd import _to_optax
+
+
+def stack_stage_params(stage_params: Sequence[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """Stack per-stage parameter dicts (identical structure) on a new
+    leading stage axis — the array-of-stages layout the pipe axis shards."""
+    first = stage_params[0]
+    for i, d in enumerate(stage_params[1:], 1):
+        if set(d) != set(first):
+            raise ValueError(
+                f"stage {i} parameter names differ from stage 0: "
+                f"{sorted(set(d) ^ set(first))}")
+    return {n: jnp.stack([jnp.asarray(d[n]) for d in stage_params])
+            for n in first}
+
+
+def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
+                   stacked_params: Dict[str, Any],
+                   x: jax.Array, *,
+                   mesh: Mesh,
+                   num_microbatches: Optional[int] = None,
+                   pipe_axis: str = PIPE_AXIS,
+                   data_axis: Optional[str] = None) -> jax.Array:
+    """Run ``x`` through all pipeline stages with a GPipe microbatch
+    schedule. Differentiable; call under ``jit`` for the fused path.
+
+    ``x``: [B, ...] — B must divide into ``num_microbatches`` (default: the
+    number of stages). ``stage_fn(params, x_mb) -> y_mb`` with
+    ``y_mb.shape == x_mb.shape``.
+    """
+    S = mesh.shape[pipe_axis]
+    n_stages = {int(np.shape(a)[0]) for a in jax.tree.leaves(stacked_params)}
+    if n_stages != {S}:
+        raise ValueError(
+            f"stacked stage axis {sorted(n_stages)} must equal the pipe "
+            f"axis size {S} (one stage per pipe device)")
+    M = int(num_microbatches or S)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    T = M + S - 1
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_device(params, mb):
+        # params arrive with a length-1 shard of the stage axis; strip it.
+        params = jax.tree.map(lambda a: a[0], params)
+        idx = lax.axis_index(pipe_axis)
+
+        def tick(state, t):
+            # stage 0 injects a fresh microbatch each tick (clamped once
+            # the input is exhausted; those ticks' results are masked off
+            # by the output slice below)
+            inj = mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(idx == 0, inj, state)
+            y = stage_fn(params, cur)
+            nxt = lax.ppermute(y, pipe_axis, ring)
+            return nxt, y
+
+        state0 = jnp.zeros_like(mb[0])
+        _, ys = lax.scan(tick, state0, jnp.arange(T))
+        # On the last stage, ys[t] is the finished microbatch t-(S-1).
+        # Broadcast the last stage's outputs to every device via a masked
+        # psum (replicated output spec over the pipe axis).
+        contrib = jnp.where(idx == S - 1, ys, jnp.zeros_like(ys))
+        outs = lax.psum(contrib, pipe_axis)
+        return outs[S - 1:S - 1 + M]
+
+    pspec = jax.tree.map(lambda _: PartitionSpec(pipe_axis), stacked_params)
+    mb_spec = PartitionSpec(None, data_axis) if data_axis else \
+        PartitionSpec()
+    out_spec = PartitionSpec(None, data_axis) if data_axis else \
+        PartitionSpec()
+    y_mb = jax.shard_map(per_device, mesh=mesh,
+                         in_specs=(pspec, mb_spec),
+                         out_specs=out_spec, check_vma=False)(
+        stacked_params, x_mb)
+    return y_mb.reshape(B, *y_mb.shape[2:])
+
+
+def _functional_apply(block, objs: "OrderedDict[str, Any]", pvals, *args):
+    """Apply a Block with parameter values injected functionally (the
+    SPMDTrainer _Trace mechanism). Returns (out, aux) where aux maps
+    parameter name -> updated value for mutated auxiliary state
+    (BatchNorm running stats)."""
+    param_map = {id(p): NDArray(pvals[n]) for n, p in objs.items()}
+    trace = _Trace(param_map)
+    _trace.stack.append(trace)
+    try:
+        with autograd._RecordingStateScope(False, True):
+            out = block.forward(*[NDArray(a) for a in args])
+    finally:
+        _trace.stack.pop()
+    id2name = {id(p): n for n, p in objs.items()}
+    aux = {id2name[i]: v for i, (p, v) in trace.aux.items() if i in id2name}
+    return out._data, aux
+
+
+def _collect(block) -> "OrderedDict[str, Any]":
+    by_name = block._collect_params_with_prefix()
+    objs: "OrderedDict[str, Any]" = OrderedDict()
+    seen = set()
+    for name, p in by_name.items():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        if p._data is None:
+            raise RuntimeError(
+                f"parameter {name} not initialized; run one eager forward "
+                "before building the pipeline")
+        objs[name] = p
+    return objs
+
+
+class PipelineTrainer:
+    """Train ``prologue -> [stage]*S -> epilogue`` with the stage list
+    pipelined over the ``pipe`` mesh axis; fused jitted step like
+    :class:`SPMDTrainer`.
+
+    ``stages`` are Blocks with identical parameter structure (e.g. S
+    instances of one transformer-layer class). ``prologue``/``epilogue``
+    run replicated outside the pipelined region.
+
+    Usage::
+
+        mesh = parallel.make_mesh({'pipe': 4, 'data': 2})
+        pt = parallel.PipelineTrainer(stages, loss_fn, 'adam',
+                                      {'learning_rate': 1e-3}, mesh=mesh,
+                                      prologue=embed, epilogue=head)
+        loss = pt.step(tokens, labels)
+    """
+
+    def __init__(self, stages: Sequence[Any], loss_fn,
+                 optimizer="sgd", optimizer_params=None, *,
+                 mesh: Optional[Mesh] = None,
+                 prologue=None, epilogue=None,
+                 num_microbatches: Optional[int] = None,
+                 pipe_axis: str = PIPE_AXIS,
+                 data_axis: Optional[str] = DATA_AXIS,
+                 donate: bool = True):
+        self.mesh = mesh if mesh is not None else make_mesh(
+            {pipe_axis: len(stages)})
+        S = self.mesh.shape[pipe_axis]
+        if len(stages) != S:
+            raise ValueError(
+                f"{len(stages)} stages but pipe axis has {S} devices")
+        self.stages = list(stages)
+        self.prologue, self.epilogue = prologue, epilogue
+        self.loss_fn = loss_fn
+        self.pipe_axis = pipe_axis
+        self.data_axis = data_axis if (
+            data_axis and data_axis in self.mesh.shape) else None
+        self.num_microbatches = num_microbatches
+        self.tx = _to_optax(optimizer, optimizer_params)
+        self._donate = donate
+        self._step_cache: Dict[Any, Callable] = {}
+
+        self._stage_objs = _collect(self.stages[0])
+        for i, st in enumerate(self.stages[1:], 1):
+            objs = _collect(st)
+            if list(objs) != list(self._stage_objs):
+                raise ValueError(
+                    f"stage {i} param structure differs from stage 0")
+        stacked = stack_stage_params(
+            [{n: p._data._data for n, p in _collect(st).items()}
+             for st in self.stages])
+        pipe_shard = lambda a: jax.device_put(a, NamedSharding(
+            self.mesh, PartitionSpec(pipe_axis)))
+        repl = lambda a: jax.device_put(a, NamedSharding(
+            self.mesh, PartitionSpec()))
+
+        self._pro_objs = _collect(prologue) if prologue is not None else \
+            OrderedDict()
+        self._epi_objs = _collect(epilogue) if epilogue is not None else \
+            OrderedDict()
+
+        # grad_req='null' parameters (frozen weights, BatchNorm running
+        # stats) live in self.frozen — never touched by the optimizer,
+        # updated only via _Trace aux writes (matching SPMDTrainer).
+        def trainable_of(objs):
+            return {n for n, p in objs.items() if p.grad_req != "null"}
+
+        stage_train = trainable_of(self._stage_objs)
+        self.params: Dict[str, Any] = {"stages": {
+            n: pipe_shard(a) for n, a in stacked.items()
+            if n in stage_train}}
+        self.frozen: Dict[str, Any] = {"stages": {
+            n: pipe_shard(a) for n, a in stacked.items()
+            if n not in stage_train}}
+        for key, objs in (("prologue", self._pro_objs),
+                          ("epilogue", self._epi_objs)):
+            train = trainable_of(objs)
+            self.params[key] = {n: repl(p._data._data)
+                                for n, p in objs.items() if n in train}
+            self.frozen[key] = {n: repl(p._data._data)
+                                for n, p in objs.items() if n not in train}
+        self.opt_state = self.tx.init(self.params)
+        self._batch_sharding = NamedSharding(
+            self.mesh, PartitionSpec(self.data_axis) if self.data_axis
+            else PartitionSpec())
+
+    def _build_step(self):
+        template = self.stages[0]
+        stage_objs = self._stage_objs
+        pro, epi = self.prologue, self.epilogue
+        pro_objs, epi_objs = self._pro_objs, self._epi_objs
+        loss_fn, tx, mesh = self.loss_fn, self.tx, self.mesh
+        pipe_axis, data_axis = self.pipe_axis, self.data_axis
+        M = self.num_microbatches
+
+        def loss_of(params, frozen, rng, x, y):
+            def stage_fn(pvals, h):
+                # stage pytrees are {train}+{frozen} merged per stage;
+                # stage-internal aux mutation is unsupported (docstring
+                # contract: no BatchNorm inside pipelined stages)
+                out, _ = _functional_apply(template, stage_objs, pvals, h)
+                return out
+
+            merged_stages = {**params["stages"], **frozen["stages"]}
+            aux_updates: Dict[str, Dict[str, Any]] = {}
+            with _random.key_provider(rng):
+                h = x
+                if pro is not None:
+                    h, aux = _functional_apply(
+                        pro, pro_objs,
+                        {**params["prologue"], **frozen["prologue"]}, h)
+                    aux_updates["prologue"] = aux
+                h = pipeline_apply(stage_fn, merged_stages, h, mesh=mesh,
+                                   num_microbatches=M, pipe_axis=pipe_axis,
+                                   data_axis=data_axis)
+                if epi is not None:
+                    h, aux = _functional_apply(
+                        epi, epi_objs,
+                        {**params["epilogue"], **frozen["epilogue"]}, h)
+                    aux_updates["epilogue"] = aux
+                with autograd._RecordingStateScope(False, True):
+                    loss = loss_fn(NDArray(h), NDArray(y))
+            return jnp.mean(loss._data.astype(jnp.float32)), aux_updates
+
+        from ..config import matmul_precision_for
+
+        precision = matmul_precision_for(
+            a.dtype for a in jax.tree.leaves((self.params, self.frozen)))
+
+        def step(params, frozen, opt_state, rng, x, y):
+            with jax.default_matmul_precision(precision):
+                (loss, aux_updates), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, frozen, rng, x, y)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+            for key, aux in aux_updates.items():
+                for n, v in aux.items():
+                    if n in frozen[key]:
+                        frozen = {**frozen, key: {**frozen[key], n: v}}
+                    elif n in params[key]:
+                        params = {**params, key: {**params[key], n: v}}
+            return params, frozen, opt_state, loss
+
+        return jax.jit(step,
+                       donate_argnums=(0, 1, 2) if self._donate else ())
+
+    def step(self, data, labels) -> float:
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        y = labels._data if isinstance(labels, NDArray) else \
+            jnp.asarray(labels)
+        x = jax.device_put(x, self._batch_sharding)
+        y = jax.device_put(y, self._batch_sharding)
+        key = (x.shape, str(x.dtype), y.shape, str(y.dtype))
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_step()
+            self._step_cache[key] = fn
+        rng = _random.next_key()
+        self.params, self.frozen, self.opt_state, loss = fn(
+            self.params, self.frozen, self.opt_state, rng, x, y)
+        return loss
+
+    def sync_to_net(self) -> None:
+        """Write trainer-owned values back into the stage/prologue/epilogue
+        Blocks (unstacking the stage axis)."""
+        stacked = {**self.params["stages"], **self.frozen["stages"]}
+        for i, st in enumerate(self.stages):
+            objs = _collect(st)
+            for n, p in objs.items():
+                p._data._set_data(stacked[n][i])
+        for key, objs in (("prologue", self._pro_objs),
+                          ("epilogue", self._epi_objs)):
+            vals = {**self.params[key], **self.frozen[key]}
+            for n, p in objs.items():
+                p._data._set_data(vals[n])

@@ -1,0 +1,202 @@
+"""Pipeline parallelism (PP) on the virtual 8-device CPU mesh.
+
+SURVEY.md §2.4 PP row: new capability (reference has only manual group2ctx
+placement). Correctness oracle = running the same stages sequentially on
+one device; the GPipe schedule must be numerically identical.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _n_devices():
+    import jax
+
+    return len(jax.devices())
+
+
+def _pipe_mesh(S):
+    import jax
+
+    return parallel.make_mesh({"pipe": S}, devices=jax.devices()[:S])
+
+
+pytestmark = pytest.mark.skipif(
+    _n_devices() < 4, reason="needs >=4 devices (virtual CPU mesh)")
+
+
+def test_pipeline_apply_matches_sequential():
+    import jax.numpy as jnp
+
+    np.random.seed(0)
+    S, D = 4, 16
+    ws = [np.random.randn(D, D).astype(np.float32) * 0.3 for _ in range(S)]
+    bs = [np.random.randn(D).astype(np.float32) * 0.1 for _ in range(S)]
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    stacked = parallel.stack_stage_params(
+        [{"w": w, "b": b} for w, b in zip(ws, bs)])
+    mesh = _pipe_mesh(S)
+    x = np.random.randn(8, D).astype(np.float32)
+
+    for M in (S, 8):  # microbatches == stages, and more than stages
+        y = np.asarray(parallel.pipeline_apply(
+            stage_fn, stacked, jnp.asarray(x), mesh=mesh,
+            num_microbatches=M))
+        ref = x
+        for w, b in zip(ws, bs):
+            ref = np.tanh(ref @ w + b)
+        np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_apply_grad_matches_sequential():
+    """The transposed pipeline (backward through scan+ppermute) must equal
+    grads of the sequential composition."""
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(1)
+    S, D = 4, 8
+    stacked = {
+        "w": jnp.asarray(np.random.randn(S, D, D).astype(np.float32) * 0.3)}
+    mesh = _pipe_mesh(S)
+    x = jnp.asarray(np.random.randn(8, D).astype(np.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def pipelined_loss(params):
+        y = parallel.pipeline_apply(stage_fn, params, x, mesh=mesh)
+        return jnp.sum(y ** 2)
+
+    def sequential_loss(params):
+        h = x
+        for i in range(S):
+            h = jnp.tanh(h @ params["w"][i])
+        return jnp.sum(h ** 2)
+
+    g_pipe = jax.grad(pipelined_loss)({"w": stacked["w"]})
+    g_seq = jax.grad(sequential_loss)({"w": stacked["w"]})
+    np.testing.assert_allclose(np.asarray(g_pipe["w"]),
+                               np.asarray(g_seq["w"]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _make_stages(n, units):
+    stages = []
+    for _ in range(n):
+        blk = nn.Dense(units, in_units=units, activation="tanh")
+        blk.initialize(init="xavier")
+        blk(mx.nd.zeros((1, units)))
+        stages.append(blk)
+    return stages
+
+
+def test_pipeline_trainer_converges():
+    np.random.seed(2)
+    mx.random.seed(2)
+    S, D, C = 4, 16, 4
+    stages = _make_stages(S, D)
+    head = nn.Dense(C, in_units=D)
+    head.initialize(init="xavier")
+    head(mx.nd.zeros((1, D)))
+
+    mesh = parallel.make_mesh({"pipe": S, "data": 2})
+    pt = parallel.PipelineTrainer(
+        stages, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 3e-3}, mesh=mesh, epilogue=head)
+    x = np.random.rand(32, D).astype(np.float32)
+    y = np.random.randint(0, C, (32,)).astype(np.float32)
+    losses = [float(pt.step(x, y)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.6, losses[::10]
+
+
+def test_pipeline_trainer_step_matches_unpipelined():
+    """One PP trainer step == the same step computed without a pipeline."""
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(3)
+    mx.random.seed(3)
+    S, D = 4, 8
+    stages = _make_stages(S, D)
+    mesh = _pipe_mesh(S)
+    pt = parallel.PipelineTrainer(
+        stages, gluon.loss.L2Loss(), "sgd", {"learning_rate": 0.1},
+        mesh=mesh, data_axis=None, donate=False)
+
+    w0 = {n: np.asarray(a) for n, a in pt.params["stages"].items()}
+    x = np.random.RandomState(0).rand(8, D).astype(np.float32)
+    y = np.random.RandomState(1).rand(8, D).astype(np.float32)
+    loss = float(pt.step(x, y))
+
+    # reference: plain jax, sequential stages, same L2 loss + SGD step
+    def ref_loss(params):
+        h = jnp.asarray(x)
+        for i in range(S):
+            h = jnp.tanh(h @ params["weight"][i].T + params["bias"][i])
+        return jnp.mean((h - y) ** 2 / 2.0)
+
+    ref_l, g = jax.value_and_grad(ref_loss)(
+        {n: jnp.asarray(a) for n, a in w0.items()})
+    assert abs(loss - float(ref_l)) < 1e-5
+    for n in w0:
+        got = np.asarray(pt.params["stages"][n])
+        want = w0[n] - 0.1 * np.asarray(g[n])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # sync_to_net writes per-stage values back
+    pt.sync_to_net()
+    got0 = stages[0].weight.data().asnumpy()
+    np.testing.assert_allclose(got0, np.asarray(pt.params["stages"]
+                                                ["weight"][0]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_pipeline_trainer_frozen_and_bn_epilogue():
+    """grad_req='null' params stay fixed; BatchNorm running stats in the
+    epilogue update through the fused step (aux write-back); a
+    parameterless prologue is accepted."""
+    np.random.seed(4)
+    mx.random.seed(4)
+    S, D = 4, 8
+    stages = _make_stages(S, D)
+    stages[0].weight.grad_req = "null"
+
+    epi = nn.HybridSequential()
+    epi.add(nn.BatchNorm(in_channels=D), nn.Dense(3, in_units=D))
+    epi.initialize(init="xavier")
+    epi(mx.nd.zeros((2, D)))
+
+    class Identity(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return x * 1.0
+
+    pro = Identity()
+    pro.initialize()
+
+    mesh = _pipe_mesh(S)
+    pt = parallel.PipelineTrainer(
+        stages, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, prologue=pro, epilogue=epi,
+        data_axis=None, donate=False)
+
+    assert "weight" in pt.frozen["stages"]
+    w_frozen0 = np.asarray(pt.frozen["stages"]["weight"])
+    rm_name = [n for n in pt.frozen["epilogue"] if "running_mean" in n][0]
+    rm0 = np.asarray(pt.frozen["epilogue"][rm_name])
+
+    x = np.random.rand(8, D).astype(np.float32) + 1.0
+    y = np.random.randint(0, 3, (8,)).astype(np.float32)
+    for _ in range(3):
+        pt.step(x, y)
+
+    np.testing.assert_array_equal(
+        np.asarray(pt.frozen["stages"]["weight"]), w_frozen0)
+    assert not np.allclose(np.asarray(pt.frozen["epilogue"][rm_name]), rm0)
