@@ -66,3 +66,63 @@ class HybridConcurrent(_HybridBlock):
 
 
 Concurrent = HybridConcurrent
+
+
+class MoEFFN(_HybridBlock):
+    """Mixture-of-experts positionwise FFN — the EP building block
+    (SURVEY.md §2.4 EP row; new capability, the reference has no MoE).
+
+    Drop-in for PositionwiseFFN with ``num_experts`` experts and top-``k``
+    routing. Expert weights are stacked on a leading expert axis so they
+    shard ``P('expert', ...)`` under an expert-parallel mesh (use
+    ``parallel.shard_params(net, {r'expert_w': P('expert')})`` or the
+    defaults in tests/test_moe.py).
+
+    With ``return_aux=True`` (recommended for training) ``forward(x)``
+    returns ``(y, aux_loss)`` so the model can add ``aux_weight *
+    aux_loss`` to its objective. With the default ``return_aux=False`` it
+    returns ``y`` alone and the most recent aux loss is available as
+    ``self.aux_loss`` right after an *eager* forward (do not read it
+    across jit/trace boundaries — return it instead).
+    """
+
+    def __init__(self, units, hidden_size, num_experts, k=2,
+                 capacity_factor=1.25, activation="gelu",
+                 return_aux=False, dtype="float32", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._hidden = hidden_size
+        self._experts = num_experts
+        self._k = k
+        self._cf = capacity_factor
+        self._act = activation
+        self._return_aux = return_aux
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(units, num_experts), dtype=dtype)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden_size),
+                dtype=dtype)
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size), dtype=dtype,
+                init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, units),
+                dtype=dtype)
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, units), dtype=dtype,
+                init="zeros")
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        y, aux = F.invoke_op(
+            "moe_ffn", x, self.gate_weight.data(), self.expert_w1.data(),
+            self.expert_b1.data(), self.expert_w2.data(),
+            self.expert_b2.data(), k=self._k, capacity_factor=self._cf,
+            activation=self._act)
+        if self._return_aux:
+            return y, aux
+        self.aux_loss = aux
+        return y

@@ -11,4 +11,5 @@ from . import rnn_op  # noqa: F401  (registers fused RNN op)
 from . import pallas_attention  # noqa: F401  (registers flash_attention)
 from . import optimizer_ops  # noqa: F401  (registers update ops)
 from . import more  # noqa: F401  (registers samplers/image/misc ops)
+from . import moe   # noqa: F401  (registers mixture-of-experts ops)
 from .registry import get, list_ops, register  # noqa: F401

@@ -1,0 +1,125 @@
+"""Mixture-of-Experts ops — the EP (expert parallelism) compute core.
+
+SURVEY.md §2.4 EP row: the reference has no MoE at all ("❌ (no MoE)");
+this is a new TPU-native capability. The design is the GShard/Switch
+einsum formulation — top-k gating, capacity-bounded dispatch expressed as
+dense one-hot einsums — because it is exactly the shape XLA SPMD
+partitions well: with the stacked expert weights sharded
+``P('expert', ...)`` and a sharding constraint on the dispatched
+activations, the ``nec,nd->ecd`` dispatch einsum lowers to the AllToAll
+over the ``expert`` mesh axis (ICI), with no manual collective code.
+
+Capacity semantics: each expert processes at most
+``C = ceil(k * N / E * capacity_factor)`` tokens; overflow tokens are
+dropped (contribute zero for that expert choice), matching Switch/GShard.
+Priority is choice-major (all tokens' first choices queue before any
+second choice).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _expert_constraint(x):
+    """If the ambient mesh has an 'expert' axis, constrain the leading
+    (expert) dim of x onto it so XLA partitions expert compute and inserts
+    the dispatch/return AllToAll over ICI."""
+    from ..parallel.mesh import EXPERT_AXIS, current_mesh
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = current_mesh()
+    if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+        spec = PartitionSpec(EXPERT_AXIS, *([None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+    return x
+
+
+@register("moe_gate_dispatch")
+def moe_gate_dispatch(logits, k=2, capacity_factor=1.25, capacity=0):
+    """Top-k gating + capacity-bounded dispatch/combine tensors.
+
+    ``logits``: (N, E). Returns ``(dispatch, combine, aux_loss)`` where
+    ``dispatch`` (N, E, C) is the 0/1 routing tensor, ``combine`` (N, E, C)
+    carries the renormalized top-k gate probabilities, and ``aux_loss`` is
+    the Switch load-balancing loss ``E * sum_e(f_e * P_e)``.
+    """
+    N, E = logits.shape
+    k = int(min(k, E))
+    C = int(capacity) if capacity else max(
+        1, int(math.ceil(k * N / E * capacity_factor)))
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, idx = jax.lax.top_k(probs, k)              # (N, k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)        # (N, k, E)
+
+    # queue position per (token, choice) within its expert, choice-major
+    flat = oh.transpose(1, 0, 2).reshape(k * N, E)
+    pos = jnp.cumsum(flat, axis=0) - flat                 # (k*N, E)
+    pos = pos.reshape(k, N, E).transpose(1, 0, 2)         # (N, k, E)
+    pos_in_expert = (pos * oh).sum(-1).astype(jnp.int32)  # (N, k)
+    # one_hot is all-zero past C -> capacity overflow drops automatically
+    pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)
+    # zero rows where the slot itself overflowed
+    pos_oh = pos_oh * (pos_in_expert < C)[..., None]
+
+    dispatch = jnp.einsum("nke,nkc->nec", oh, pos_oh)
+    combine = jnp.einsum("nke,nkc,nk->nec", oh, pos_oh, gate_vals)
+
+    # fraction of routed slots landing on each expert (post-capacity)
+    f = dispatch.sum((0, 2)) / max(N * k, 1)
+    P = probs.mean(0)
+    aux_loss = E * jnp.sum(f * P)
+    return dispatch, combine, aux_loss
+
+
+@register("moe_ffn")
+def moe_ffn(x, gate_w, w1, b1, w2, b2, k=2, capacity_factor=1.25,
+            capacity=0, activation="gelu"):
+    """Mixture-of-experts positionwise FFN.
+
+    ``x``: (..., d); ``gate_w``: (d, E); expert weights stacked on a
+    leading expert axis: ``w1`` (E, d, h), ``b1`` (E, h), ``w2`` (E, h, d),
+    ``b2`` (E, d). Returns ``(y, aux_loss)`` with ``y.shape == x.shape``.
+
+    Under a mesh with an ``expert`` axis (and expert weights sharded
+    ``P('expert', ...)``) the dispatched activations are constrained onto
+    that axis, so XLA lowers dispatch/return to AllToAll over ICI — the
+    EP communication path with zero manual collectives.
+    """
+    orig_shape = x.shape
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)
+    E = w1.shape[0]
+
+    logits = (xf @ gate_w.astype(xf.dtype)).astype(jnp.float32)
+    dispatch, combine, aux_loss = moe_gate_dispatch(
+        logits, k=k, capacity_factor=capacity_factor, capacity=capacity)
+    dispatch = dispatch.astype(xf.dtype)
+    combine = combine.astype(xf.dtype)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    expert_in = _expert_constraint(expert_in)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :]
+    if activation == "gelu":
+        h = jax.nn.gelu(h)
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    elif activation in (None, "identity", "none"):
+        pass
+    else:
+        raise ValueError(f"unsupported moe activation {activation!r}")
+    h = _expert_constraint(h)
+    out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    out = _expert_constraint(out)
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    return y.reshape(orig_shape), aux_loss.astype(jnp.float32)

@@ -66,9 +66,20 @@ def _index_to_json(index, shape) -> List[List[int]]:
     return out
 
 
+def _flat_items(d: Dict[str, Any], prefix: str = ""):
+    """Yield (joined-name, array) over a possibly-nested dict — flat for
+    SPMDTrainer, one level of group nesting for PipelineTrainer
+    ({'stages': {...}, 'prologue': {...}, ...})."""
+    for n, v in d.items():
+        if isinstance(v, dict):
+            yield from _flat_items(v, f"{prefix}{n}/")
+        else:
+            yield f"{prefix}{n}", v
+
+
 def _flatten_state(params: Dict[str, Any], opt_state, frozen) -> Dict[str, Any]:
-    flat = {f"param/{n}": v for n, v in params.items()}
-    flat.update({f"frozen/{n}": v for n, v in frozen.items()})
+    flat = {f"param/{n}": v for n, v in _flat_items(params)}
+    flat.update({f"frozen/{n}": v for n, v in _flat_items(frozen)})
     leaves = jax.tree_util.tree_leaves(opt_state)
     for i, leaf in enumerate(leaves):
         if hasattr(leaf, "shape"):
@@ -172,19 +183,24 @@ def restore_sharded(prefix: str, trainer) -> None:
         sharding = NamedSharding(mesh, _spec_from_json(entry["spec"]))
         return jax.device_put(jnp.asarray(full), sharding)
 
-    new_params = {}
-    for n in trainer.params:
-        key = f"param/{n}"
-        if key not in manifest["tensors"]:
-            raise KeyError(f"checkpoint missing parameter {n}")
-        new_params[n] = build(key)
-    new_frozen = {}
-    for n in trainer.frozen:
-        key = f"frozen/{n}"
-        if key in manifest["tensors"]:
-            new_frozen[n] = build(key)
-        else:
-            new_frozen[n] = trainer.frozen[n]
+    def rebuild(tree: Dict[str, Any], group: str, prefix: str = "",
+                required: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for n, v in tree.items():
+            if isinstance(v, dict):
+                out[n] = rebuild(v, group, f"{prefix}{n}/", required)
+                continue
+            key = f"{group}/{prefix}{n}"
+            if key in manifest["tensors"]:
+                out[n] = build(key)
+            elif required:
+                raise KeyError(f"checkpoint missing parameter {prefix}{n}")
+            else:
+                out[n] = v
+        return out
+
+    new_params = rebuild(trainer.params, "param")
+    new_frozen = rebuild(trainer.frozen, "frozen", required=False)
 
     leaves, treedef = jax.tree_util.tree_flatten(trainer.opt_state)
     new_leaves = []

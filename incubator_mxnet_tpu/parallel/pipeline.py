@@ -45,11 +45,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import autograd
 from .. import random as _random
-from ..gluon.block import _Trace
-from ..gluon.parameter import _trace
 from ..ndarray import NDArray
-from .mesh import DATA_AXIS, PIPE_AXIS, make_mesh
-from .spmd import _to_optax
+from .mesh import DATA_AXIS, PIPE_AXIS, make_mesh, mesh_scope
+from .spmd import _to_optax, collect_params, functional_apply
 
 
 def stack_stage_params(stage_params: Sequence[Dict[str, Any]]
@@ -130,40 +128,6 @@ def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
     return y_mb.reshape(B, *y_mb.shape[2:])
 
 
-def _functional_apply(block, objs: "OrderedDict[str, Any]", pvals, *args):
-    """Apply a Block with parameter values injected functionally (the
-    SPMDTrainer _Trace mechanism). Returns (out, aux) where aux maps
-    parameter name -> updated value for mutated auxiliary state
-    (BatchNorm running stats)."""
-    param_map = {id(p): NDArray(pvals[n]) for n, p in objs.items()}
-    trace = _Trace(param_map)
-    _trace.stack.append(trace)
-    try:
-        with autograd._RecordingStateScope(False, True):
-            out = block.forward(*[NDArray(a) for a in args])
-    finally:
-        _trace.stack.pop()
-    id2name = {id(p): n for n, p in objs.items()}
-    aux = {id2name[i]: v for i, (p, v) in trace.aux.items() if i in id2name}
-    return out._data, aux
-
-
-def _collect(block) -> "OrderedDict[str, Any]":
-    by_name = block._collect_params_with_prefix()
-    objs: "OrderedDict[str, Any]" = OrderedDict()
-    seen = set()
-    for name, p in by_name.items():
-        if id(p) in seen:
-            continue
-        seen.add(id(p))
-        if p._data is None:
-            raise RuntimeError(
-                f"parameter {name} not initialized; run one eager forward "
-                "before building the pipeline")
-        objs[name] = p
-    return objs
-
-
 class PipelineTrainer:
     """Train ``prologue -> [stage]*S -> epilogue`` with the stage list
     pipelined over the ``pipe`` mesh axis; fused jitted step like
@@ -207,23 +171,23 @@ class PipelineTrainer:
         self._donate = donate
         self._step_cache: Dict[Any, Callable] = {}
 
-        self._stage_objs = _collect(self.stages[0])
+        self._stage_objs = collect_params(self.stages[0])
         for i, st in enumerate(self.stages[1:], 1):
-            objs = _collect(st)
+            objs = collect_params(st)
             if list(objs) != list(self._stage_objs):
                 raise ValueError(
                     f"stage {i} param structure differs from stage 0")
         stacked = stack_stage_params(
-            [{n: p._data._data for n, p in _collect(st).items()}
+            [{n: p._data._data for n, p in collect_params(st).items()}
              for st in self.stages])
         pipe_shard = lambda a: jax.device_put(a, NamedSharding(
             self.mesh, PartitionSpec(pipe_axis)))
         repl = lambda a: jax.device_put(a, NamedSharding(
             self.mesh, PartitionSpec()))
 
-        self._pro_objs = _collect(prologue) if prologue is not None else \
+        self._pro_objs = collect_params(prologue) if prologue is not None else \
             OrderedDict()
-        self._epi_objs = _collect(epilogue) if epilogue is not None else \
+        self._epi_objs = collect_params(epilogue) if epilogue is not None else \
             OrderedDict()
 
         # grad_req='null' parameters (frozen weights, BatchNorm running
@@ -265,7 +229,7 @@ class PipelineTrainer:
                 # stage pytrees are {train}+{frozen} merged per stage;
                 # stage-internal aux mutation is unsupported (docstring
                 # contract: no BatchNorm inside pipelined stages)
-                out, _ = _functional_apply(template, stage_objs, pvals, h)
+                out, _ = functional_apply(template, stage_objs, pvals, h)
                 return out
 
             merged_stages = {**params["stages"], **frozen["stages"]}
@@ -273,7 +237,7 @@ class PipelineTrainer:
             with _random.key_provider(rng):
                 h = x
                 if pro is not None:
-                    h, aux = _functional_apply(
+                    h, aux = functional_apply(
                         pro, pro_objs,
                         {**params["prologue"], **frozen["prologue"]}, h)
                     aux_updates["prologue"] = aux
@@ -281,7 +245,7 @@ class PipelineTrainer:
                                    num_microbatches=M, pipe_axis=pipe_axis,
                                    data_axis=data_axis)
                 if epi is not None:
-                    h, aux = _functional_apply(
+                    h, aux = functional_apply(
                         epi, epi_objs,
                         {**params["epilogue"], **frozen["epilogue"]}, h)
                     aux_updates["epilogue"] = aux
@@ -323,8 +287,12 @@ class PipelineTrainer:
             fn = self._build_step()
             self._step_cache[key] = fn
         rng = _random.next_key()
-        self.params, self.frozen, self.opt_state, loss = fn(
-            self.params, self.frozen, self.opt_state, rng, x, y)
+        # trace/execute under the ambient-mesh scope so mesh-aware ops in
+        # prologue/epilogue (e.g. moe_ffn) see self.mesh (same as
+        # SPMDTrainer.step)
+        with mesh_scope(self.mesh):
+            self.params, self.frozen, self.opt_state, loss = fn(
+                self.params, self.frozen, self.opt_state, rng, x, y)
         return loss
 
     def sync_to_net(self) -> None:
@@ -332,7 +300,7 @@ class PipelineTrainer:
         Blocks (unstacking the stage axis)."""
         stacked = {**self.params["stages"], **self.frozen["stages"]}
         for i, st in enumerate(self.stages):
-            objs = _collect(st)
+            objs = collect_params(st)
             for n, p in objs.items():
                 p._data._set_data(stacked[n][i])
         for key, objs in (("prologue", self._pro_objs),

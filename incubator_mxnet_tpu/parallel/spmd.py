@@ -73,6 +73,43 @@ def _to_optax(optimizer, optimizer_params: Optional[dict]):
     return tx
 
 
+def collect_params(block) -> "OrderedDict[str, Parameter]":
+    """Collect a Block's unique initialized Parameters by structural name
+    (shared by SPMDTrainer and PipelineTrainer)."""
+    by_name = block._collect_params_with_prefix()
+    objs: "OrderedDict[str, Parameter]" = OrderedDict()
+    seen = set()
+    for name, p in by_name.items():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        if p._data is None:
+            raise RuntimeError(
+                f"parameter {name} not initialized; run one eager forward "
+                "(or pass explicit shapes) before building the trainer")
+        objs[name] = p
+    return objs
+
+
+def functional_apply(block, objs: "OrderedDict[str, Parameter]", pvals,
+                     *args):
+    """Apply a Block with parameter values injected functionally via the
+    _Trace mechanism. Returns ``(out_jax, aux)`` where ``aux`` maps
+    parameter name -> updated value for mutated auxiliary state
+    (BatchNorm running stats)."""
+    param_map = {id(p): NDArray(pvals[n]) for n, p in objs.items()}
+    trace = _Trace(param_map)
+    _trace.stack.append(trace)
+    try:
+        with autograd._RecordingStateScope(False, True):
+            out = block.forward(*[NDArray(a) for a in args])
+    finally:
+        _trace.stack.pop()
+    id2name = {id(p): n for n, p in objs.items()}
+    aux = {id2name[i]: v for i, (p, v) in trace.aux.items() if i in id2name}
+    return out._data, aux
+
+
 def shard_params(net, rules: Dict[str, PartitionSpec]) -> None:
     """Attach PartitionSpec sharding rules to parameters by regex on the
     structural name — the TP/SP analog of the reference's ``group2ctx``
@@ -109,18 +146,7 @@ class SPMDTrainer:
         self._num_steps = 0
         self._donate = donate
 
-        by_name = net._collect_params_with_prefix()
-        self._param_objs: "OrderedDict[str, Parameter]" = OrderedDict()
-        seen = set()
-        for name, p in by_name.items():
-            if id(p) in seen:
-                continue
-            seen.add(id(p))
-            if p._data is None:
-                raise RuntimeError(
-                    f"parameter {name} not initialized; run one eager "
-                    "forward (or pass explicit shapes) before SPMDTrainer")
-            self._param_objs[name] = p
+        self._param_objs = collect_params(net)
         self._trainable = {n: p for n, p in self._param_objs.items()
                            if p.grad_req != "null"}
         self._frozen = {n: p for n, p in self._param_objs.items()
@@ -219,9 +245,14 @@ class SPMDTrainer:
             self._step_cache[key] = fn
         self._num_steps += 1
         rng = _random.next_key()
-        self.params, self.frozen, self.opt_state, loss = fn(
-            self.params, self.frozen, self.opt_state, rng, data_arrays,
-            label_arrays)
+        # trace/execute under the ambient-mesh scope so mesh-aware ops
+        # (e.g. moe_ffn's expert-axis sharding constraint) see self.mesh
+        from .mesh import mesh_scope
+
+        with mesh_scope(self.mesh):
+            self.params, self.frozen, self.opt_state, loss = fn(
+                self.params, self.frozen, self.opt_state, rng, data_arrays,
+                label_arrays)
         return loss
 
     def sync_to_net(self) -> None:
