@@ -22,6 +22,7 @@ from ..ops import registry as _registry
 from ..ops import tensor as _t  # ensure registration  # noqa: F401
 from ..ops import nn as _nn  # noqa: F401
 from ..ops import random_ops as _r  # noqa: F401
+from ..ops import numpy_ops as _npo  # noqa: F401
 
 _this = _sys.modules[__name__]
 
@@ -68,6 +69,16 @@ _UNARY = [
     "gelu", "silu", "mish", "hard_sigmoid", "Activation", "activation",
     "l2_normalization", "L2Normalization", "adaptive_avg_pool2d",
     "boolean_mask_unused",
+    # numpy-parity wave (ops/numpy_ops.py)
+    "exp2", "signbit", "sinc", "i0", "fabs", "invert", "bitwise_not",
+    "std", "var", "average", "median", "quantile", "percentile", "ptp",
+    "nanmax", "nanmin", "nanmean", "nanstd", "nanvar", "nanargmax",
+    "nanargmin", "nancumsum", "nancumprod", "cumprod", "count_nonzero",
+    "roll", "rot90", "tril", "triu", "trace_op", "trace", "flipud",
+    "fliplr", "moveaxis", "rollaxis", "diff", "ediff1d", "resize_op",
+    "np_resize", "vander", "unique", "nonzero", "flatnonzero", "argwhere",
+    "bincount", "histogram", "partition_op", "np_partition",
+    "argpartition", "atleast_2d", "atleast_3d", "lexsort",
 ]
 _BINARY = [
     "elemwise_add", "broadcast_add", "add", "elemwise_sub", "broadcast_sub",
@@ -84,9 +95,20 @@ _BINARY = [
     "slice_like", "sequence_mask", "sequence_last", "sequence_reverse",
     "Embedding", "embedding", "one_hot_pair_unused",
     "softmax_cross_entropy", "SoftmaxOutput", "softmax_output",
+    # numpy-parity wave (ops/numpy_ops.py)
+    "logaddexp", "logaddexp2", "copysign", "heaviside", "ldexp",
+    "float_power", "fmod", "nextafter", "floor_divide", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "left_shift", "right_shift", "allclose",
+    "isclose", "array_equal", "kron", "outer", "inner", "vdot",
+    "tensordot", "cross", "polyval", "trapz", "convolve", "correlate",
+    "searchsorted", "digitize", "setdiff1d", "intersect1d", "union1d",
+    "isin",
 ]
-_TERNARY = ["where", "scatter_nd"]
-_VARIADIC = ["concat", "concatenate", "stack", "khatri_rao"]
+_TERNARY = ["where", "scatter_nd", "interp"]
+_VARIADIC = ["concat", "concatenate", "stack", "khatri_rao",
+             "hstack", "vstack", "dstack", "column_stack",
+             "meshgrid", "broadcast_arrays", "einsum",
+             "clip_by_global_norm"]
 
 for _n in _UNARY:
     if _registry.get(_n) is not None:

@@ -1,0 +1,169 @@
+"""``mx.np`` — the numpy-compatible front (reference MXNet 2.x
+``python/mxnet/numpy/``, SURVEY.md §2.2 ndarray-module row "mx.np/npx
+numpy-compatible front").
+
+In the reference this is a separate operator universe (``src/operator/
+numpy/``) with numpy broadcasting/dtype semantics distinct from legacy
+``mx.nd``. Here the backing arrays are jax arrays, whose semantics ARE
+numpy's — so ``mx.np`` is a naming front over the same registry +
+``invoke`` path (autograd capture included), not a second dispatch world.
+Functions return :class:`~incubator_mxnet_tpu.ndarray.NDArray`.
+
+Dynamic-shape ops (unique/nonzero/bincount/...) execute eagerly, like the
+reference's CPU FCompute path; everything else traces under hybridize/jit.
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as _onp
+
+from .. import ndarray as _nd
+from ..ndarray import NDArray as ndarray  # numpy-style class alias
+from ..ndarray import (array, arange, empty, eye, full, ones, ones_like,
+                       zeros, zeros_like)
+from ..ops import registry as _registry
+from ..ops import numpy_ops as _numpy_ops  # noqa: F401 (registers the wave)
+
+_this = _sys.modules[__name__]
+
+# numpy name -> registry/nd name (identity unless stated)
+_ALIASES = {
+    "add": "elemwise_add", "subtract": "elemwise_sub",
+    "multiply": "elemwise_mul", "divide": "elemwise_div",
+    "true_divide": "elemwise_div", "power": "broadcast_power",
+    "remainder": "broadcast_mod", "mod": "broadcast_mod",
+    "absolute": "abs", "concatenate": "concat",
+    "amax": "max", "amin": "min", "round": "round",
+    "trace": "trace_op", "resize": "resize_op",
+    "partition": "partition_op", "swapaxes": "swapaxes",
+    "greater": "broadcast_greater", "greater_equal":
+        "broadcast_greater_equal", "less": "broadcast_lesser",
+    "less_equal": "broadcast_lesser_equal", "equal": "broadcast_equal",
+    "not_equal": "broadcast_not_equal",
+    "maximum": "broadcast_maximum", "minimum": "broadcast_minimum",
+    "hypot": "broadcast_hypot",
+    "logical_and": "broadcast_logical_and",
+    "logical_or": "broadcast_logical_or",
+    "logical_xor": "broadcast_logical_xor",
+    "deg2rad": "radians", "rad2deg": "degrees",
+}
+
+_PASSTHROUGH = [
+    # elementwise
+    "abs", "sign", "rint", "ceil", "floor", "trunc", "fix", "square",
+    "sqrt", "cbrt", "exp", "exp2", "expm1", "log", "log10", "log2",
+    "log1p", "reciprocal", "negative", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "degrees", "radians", "clip", "isnan", "isinf", "isfinite",
+    "nan_to_num", "sinc", "i0", "fabs", "signbit", "copysign", "heaviside",
+    "ldexp", "float_power", "fmod", "nextafter", "logaddexp", "logaddexp2",
+    "floor_divide", "invert", "bitwise_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "left_shift", "right_shift", "logical_not",
+    # reductions
+    "sum", "mean", "prod", "max", "min", "argmax", "argmin", "std", "var",
+    "average", "median", "quantile", "percentile", "ptp", "cumsum",
+    "cumprod", "nansum", "nanprod", "nanmax", "nanmin", "nanmean",
+    "nanstd", "nanvar", "nanargmax", "nanargmin", "nancumsum",
+    "nancumprod", "count_nonzero", "allclose", "isclose", "array_equal",
+    "logsumexp",
+    # shape
+    "reshape", "transpose", "expand_dims", "squeeze", "flip", "flipud",
+    "fliplr", "roll", "rot90", "tril", "triu", "tile", "repeat", "pad",
+    "split", "stack", "moveaxis", "rollaxis", "diff", "ediff1d",
+    "broadcast_to", "atleast_2d", "atleast_3d", "diag",
+    # joining
+    "hstack", "vstack", "dstack", "column_stack", "meshgrid",
+    "broadcast_arrays",
+    # linalg/products
+    "dot", "matmul", "kron", "outer", "inner", "vdot", "tensordot",
+    "cross", "vander", "polyval", "trapz", "convolve", "correlate",
+    # sorting/searching
+    "sort", "argsort", "searchsorted", "digitize", "lexsort",
+    "argpartition", "where", "take", "one_hot",
+    # dynamic-shape (eager)
+    "unique", "nonzero", "flatnonzero", "argwhere", "bincount",
+    "histogram", "setdiff1d", "intersect1d", "union1d", "isin", "interp",
+    # misc
+    "interp", "gather_nd",
+]
+
+for _np_name in _PASSTHROUGH:
+    _target = _ALIASES.get(_np_name, _np_name)
+    _fn = getattr(_nd, _target, None)
+    if _fn is not None:
+        setattr(_this, _np_name, _fn)
+
+for _np_name, _target in _ALIASES.items():
+    _fn = getattr(_nd, _target, None)
+    if _fn is not None and not hasattr(_this, _np_name):
+        setattr(_this, _np_name, _fn)
+
+
+def einsum(subscripts, *operands):
+    """numpy-style einsum (subscripts first)."""
+    return _nd.invoke_op("einsum", *operands, subscripts=subscripts)
+
+
+def concatenate(seq, axis=0):
+    return _nd.concat(*seq, dim=axis)
+
+
+def append(arr, values, axis=None):
+    if axis is None:
+        return _nd.concat(arr.reshape(-1), values.reshape(-1), dim=0)
+    return _nd.concat(arr, values, dim=axis)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None):
+    return array(_onp.linspace(start, stop, num, endpoint=endpoint,
+                               dtype=dtype))
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None):
+    return array(_onp.logspace(start, stop, num, endpoint=endpoint,
+                               base=base, dtype=dtype))
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None):
+    return array(_onp.geomspace(start, stop, num, endpoint=endpoint,
+                                dtype=dtype))
+
+
+def identity(n, dtype=None):
+    return eye(n, dtype=dtype or "float32")
+
+
+def full_like(a, fill_value, dtype=None):
+    return full(a.shape, fill_value, dtype=dtype or a.dtype)
+
+
+def empty_like(a, dtype=None):
+    return zeros(a.shape, dtype=dtype or a.dtype)
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, ndarray):
+        return a.astype(dtype) if dtype is not None else a
+    return array(a, dtype=dtype)
+
+
+newaxis = None
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int32 = _onp.int32
+int64 = _onp.int64
+int8 = _onp.int8
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+
+
+from . import random  # noqa: E402,F401

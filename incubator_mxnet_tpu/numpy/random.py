@@ -1,0 +1,61 @@
+"""``mx.np.random`` — numpy-style samplers over the framework RNG
+(reference ``python/mxnet/numpy/random.py``)."""
+
+from __future__ import annotations
+
+from .. import ndarray as _nd
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None):
+    return _nd.invoke_op("random_uniform", low=low, high=high,
+                         shape=size if size is not None else (),
+                         dtype=dtype or "float32")
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None):
+    return _nd.invoke_op("random_normal", loc=loc, scale=scale,
+                         shape=size if size is not None else (),
+                         dtype=dtype or "float32")
+
+
+def randint(low, high=None, size=None, dtype=None):
+    if high is None:
+        low, high = 0, low
+    return _nd.invoke_op("random_randint", low=low, high=high,
+                         shape=size if size is not None else (),
+                         dtype=dtype or "int32")
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size or ())
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size or ())
+
+
+def exponential(scale=1.0, size=None):
+    return _nd.invoke_op("random_exponential", lam=1.0 / scale,
+                         shape=size if size is not None else ())
+
+
+def gamma(shape, scale=1.0, size=None):
+    return _nd.invoke_op("random_gamma", alpha=shape, beta=scale,
+                         shape=size if size is not None else ())
+
+
+def poisson(lam=1.0, size=None):
+    return _nd.invoke_op("random_poisson", lam=lam,
+                         shape=size if size is not None else ())
+
+
+def shuffle(x):
+    """In-place permutation along the first axis (numpy semantics)."""
+    out = _nd.shuffle(x)
+    x._set_data(out._data)
+
+
+def seed(s):
+    from .. import random as _random
+
+    _random.seed(s)

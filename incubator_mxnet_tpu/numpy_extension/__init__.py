@@ -1,0 +1,50 @@
+"""``mx.npx`` — numpy-extension namespace (reference MXNet 2.x
+``python/mxnet/numpy_extension/`` + ``ndarray/numpy_extension``): the
+neural-network and framework ops that have no numpy equivalent, surfaced
+alongside ``mx.np``.
+
+``set_np``/``reset_np`` exist for API parity. In the reference they flip
+the global numpy-semantics switch (affecting shape (), dtype promotion,
+and Gluon block signatures); here numpy semantics are the native behavior
+of the jax substrate, so they only record the flag.
+"""
+
+from __future__ import annotations
+
+from ..ndarray import (Activation as activation, BatchNorm as batch_norm,
+                       Convolution as convolution, Dropout as dropout,
+                       Embedding as embedding,
+                       FullyConnected as fully_connected,
+                       LayerNorm as layer_norm, Pooling as pooling,
+                       gather_nd, log_softmax, one_hot, pick, relu,
+                       reshape_like, sigmoid, softmax, topk)
+from ..ndarray import batch_dot, sequence_mask
+from ..ndarray import gelu, silu  # activation extras
+
+_np_active = False
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Enable numpy semantics (no-op here beyond recording: numpy
+    semantics are native — see module docstring)."""
+    global _np_active
+    _np_active = True
+
+
+def reset_np():
+    global _np_active
+    _np_active = False
+
+
+def is_np_array():
+    return _np_active
+
+
+def is_np_shape():
+    return _np_active
+
+
+def use_np(func_or_cls):
+    """Decorator parity with reference ``mx.util.use_np``: activates numpy
+    semantics for the wrapped callable (identity here)."""
+    return func_or_cls

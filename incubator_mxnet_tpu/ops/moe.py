@@ -69,8 +69,6 @@ def moe_gate_dispatch(logits, k=2, capacity_factor=1.25, capacity=0):
     pos_in_expert = (pos * oh).sum(-1).astype(jnp.int32)  # (N, k)
     # one_hot is all-zero past C -> capacity overflow drops automatically
     pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)
-    # zero rows where the slot itself overflowed
-    pos_oh = pos_oh * (pos_in_expert < C)[..., None]
 
     dispatch = jnp.einsum("nke,nkc->nec", oh, pos_oh)
     combine = jnp.einsum("nke,nkc,nk->nec", oh, pos_oh, gate_vals)

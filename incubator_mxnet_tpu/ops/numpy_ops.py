@@ -1,0 +1,518 @@
+"""numpy-parity op wave — the registry backing for the ``mx.np`` front.
+
+Reference: MXNet 2.x ships a numpy-compatible operator set
+(``src/operator/numpy/``, SURVEY.md §2.1 operator-library row "numpy-
+compatible ops") surfaced as ``mx.np``/``mx.npx``. Here the ops are thin
+pure-jax functions (jnp already IS numpy semantics); the value added is
+registry membership — autograd capture, ``mx.nd``/``mx.np`` wrappers,
+opperf sweeps — and eager-only support for the dynamic-shape ops jit
+can't express (unique/nonzero/bincount return data-dependent shapes; the
+reference computes them on the engine's CPU path too).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# --- elementwise / math ------------------------------------------------------
+
+@register("exp2")
+def exp2(x):
+    return jnp.exp2(x)
+
+
+@register("logaddexp")
+def logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@register("logaddexp2")
+def logaddexp2(a, b):
+    return jnp.logaddexp2(a, b)
+
+
+@register("copysign")
+def copysign(a, b):
+    return jnp.copysign(a, b)
+
+
+@register("heaviside")
+def heaviside(a, b):
+    return jnp.heaviside(a, b)
+
+
+@register("ldexp")
+def ldexp(a, b):
+    return jnp.ldexp(a, b.astype(jnp.int32))
+
+
+@register("float_power")
+def float_power(a, b):
+    return jnp.float_power(a, b)
+
+
+@register("fmod")
+def fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@register("nextafter")
+def nextafter(a, b):
+    return jnp.nextafter(a, b)
+
+
+@register("signbit", differentiable=False)
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@register("sinc")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@register("i0")
+def i0(x):
+    return jnp.i0(x)
+
+
+@register("floor_divide", aliases=("broadcast_floor_divide",))
+def floor_divide(a, b):
+    return jnp.floor_divide(a, b)
+
+
+@register("fabs")
+def fabs(x):
+    return jnp.abs(x)
+
+
+@register("invert", aliases=("bitwise_not",), differentiable=False)
+def invert(x):
+    return jnp.invert(x.astype(jnp.int32))
+
+
+@register("bitwise_and", differentiable=False)
+def bitwise_and(a, b):
+    return jnp.bitwise_and(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("bitwise_or", differentiable=False)
+def bitwise_or(a, b):
+    return jnp.bitwise_or(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("bitwise_xor", differentiable=False)
+def bitwise_xor(a, b):
+    return jnp.bitwise_xor(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("left_shift", differentiable=False)
+def left_shift(a, b):
+    return jnp.left_shift(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("right_shift", differentiable=False)
+def right_shift(a, b):
+    return jnp.right_shift(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+# --- reductions / statistics -------------------------------------------------
+
+@register("std")
+def std(x, axis=None, ddof=0, keepdims=False):
+    return jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("var")
+def var(x, axis=None, ddof=0, keepdims=False):
+    return jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("average")
+def average(x, weights=None, axis=None):
+    if weights is not None:
+        weights = jnp.asarray(getattr(weights, "_data", weights))
+    return jnp.average(x, axis=axis, weights=weights)
+
+
+@register("median")
+def median(x, axis=None, keepdims=False):
+    return jnp.median(x, axis=axis, keepdims=keepdims)
+
+
+@register("quantile")
+def quantile(x, q=0.5, axis=None, keepdims=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdims)
+
+
+@register("percentile")
+def percentile(x, q=50.0, axis=None, keepdims=False):
+    return jnp.percentile(x, q, axis=axis, keepdims=keepdims)
+
+
+@register("ptp")
+def ptp(x, axis=None, keepdims=False):
+    return jnp.ptp(x, axis=axis, keepdims=keepdims)
+
+
+@register("nanmax")
+def nanmax(x, axis=None, keepdims=False):
+    return jnp.nanmax(x, axis=axis, keepdims=keepdims)
+
+
+@register("nanmin")
+def nanmin(x, axis=None, keepdims=False):
+    return jnp.nanmin(x, axis=axis, keepdims=keepdims)
+
+
+@register("nanmean")
+def nanmean(x, axis=None, keepdims=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdims)
+
+
+@register("nanstd")
+def nanstd(x, axis=None, ddof=0, keepdims=False):
+    return jnp.nanstd(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("nanvar")
+def nanvar(x, axis=None, ddof=0, keepdims=False):
+    return jnp.nanvar(x, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("nanargmax", differentiable=False)
+def nanargmax(x, axis=None):
+    return jnp.nanargmax(x, axis=axis)
+
+
+@register("nanargmin", differentiable=False)
+def nanargmin(x, axis=None):
+    return jnp.nanargmin(x, axis=axis)
+
+
+@register("nancumsum")
+def nancumsum(x, axis=None):
+    return jnp.nancumsum(x, axis=axis)
+
+
+@register("nancumprod")
+def nancumprod(x, axis=None):
+    return jnp.nancumprod(x, axis=axis)
+
+
+@register("cumprod")
+def cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+@register("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdims=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdims)
+
+
+@register("allclose", differentiable=False)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("isclose", differentiable=False)
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("array_equal", differentiable=False)
+def array_equal(a, b):
+    return jnp.array_equal(a, b)
+
+
+# --- shape / rearrangement ---------------------------------------------------
+
+@register("roll")
+def roll(x, shift=1, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+@register("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register("tril")
+def tril(x, k=0):
+    return jnp.tril(x, k=k)
+
+
+@register("triu")
+def triu(x, k=0):
+    return jnp.triu(x, k=k)
+
+
+@register("trace_op", aliases=("trace",))
+def trace_op(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("flipud")
+def flipud(x):
+    return jnp.flipud(x)
+
+
+@register("fliplr")
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+@register("moveaxis")
+def moveaxis(x, source=0, destination=0):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register("rollaxis")
+def rollaxis(x, axis=0, start=0):
+    return jnp.rollaxis(x, axis, start)
+
+
+@register("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register("ediff1d")
+def ediff1d(x):
+    return jnp.ediff1d(x)
+
+
+@register("hstack")
+def hstack(*arrays):
+    return jnp.hstack(arrays)
+
+
+@register("vstack")
+def vstack(*arrays):
+    return jnp.vstack(arrays)
+
+
+@register("dstack")
+def dstack(*arrays):
+    return jnp.dstack(arrays)
+
+
+@register("column_stack")
+def column_stack(*arrays):
+    return jnp.column_stack(arrays)
+
+
+@register("meshgrid")
+def meshgrid(*arrays, indexing="xy"):
+    return tuple(jnp.meshgrid(*arrays, indexing=indexing))
+
+
+@register("broadcast_arrays")
+def broadcast_arrays(*arrays):
+    return tuple(jnp.broadcast_arrays(*arrays))
+
+
+@register("atleast_2d")
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@register("atleast_3d")
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+@register("resize_op", aliases=("np_resize",))
+def resize_op(x, new_shape=()):
+    # numpy resize semantics: tile-and-truncate to new_shape
+    n = int(np.prod(new_shape))
+    flat = x.reshape(-1)
+    reps = -(-n // max(flat.shape[0], 1))
+    return jnp.tile(flat, reps)[:n].reshape(new_shape)
+
+
+# --- linear algebra / products ----------------------------------------------
+
+@register("kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register("inner")
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+@register("vdot")
+def vdot(a, b):
+    return jnp.vdot(a, b)
+
+
+@register("tensordot")
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(ax) if isinstance(ax, (list, tuple)) else ax
+                     for ax in axes)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register("einsum")
+def einsum(*arrays, subscripts=""):
+    return jnp.einsum(subscripts, *arrays)
+
+
+@register("cross")
+def cross(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@register("vander")
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@register("polyval")
+def polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+@register("trapz")
+def trapz(y, x=None, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@register("convolve")
+def convolve(a, v, mode="full"):
+    return jnp.convolve(a, v, mode=mode)
+
+
+@register("correlate")
+def correlate(a, v, mode="valid"):
+    return jnp.correlate(a, v, mode=mode)
+
+
+# --- searching / sorting -----------------------------------------------------
+
+@register("searchsorted", differentiable=False)
+def searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@register("digitize", differentiable=False)
+def digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+@register("lexsort", differentiable=False)
+def lexsort(keys, axis=-1):
+    return jnp.lexsort(keys, axis=axis)
+
+
+@register("partition_op", aliases=("np_partition",), differentiable=False)
+def partition_op(x, kth=0, axis=-1):
+    return jnp.partition(x, kth, axis=axis)
+
+
+@register("argpartition", differentiable=False)
+def argpartition(x, kth=0, axis=-1):
+    return jnp.argpartition(x, kth, axis=axis)
+
+
+# --- dynamic-shape ops (EAGER ONLY — data-dependent output shapes) -----------
+# jit cannot express these without a static size bound; like the reference
+# (which runs them as CPU FCompute kernels), they execute eagerly.
+
+@register("unique", differentiable=False)
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False):
+    """Eager-only (data-dependent shape)."""
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@register("nonzero", differentiable=False)
+def nonzero(x):
+    """Eager-only (data-dependent shape); returns the numpy-style tuple of
+    per-dimension index arrays."""
+    return tuple(jnp.asarray(r) for r in np.nonzero(np.asarray(x)))
+
+
+@register("flatnonzero", differentiable=False)
+def flatnonzero(x):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.flatnonzero(np.asarray(x)))
+
+
+@register("argwhere", differentiable=False)
+def argwhere(x):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.argwhere(np.asarray(x)))
+
+
+@register("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.bincount(
+        np.asarray(x).astype(np.int64),
+        weights=None if weights is None else np.asarray(weights),
+        minlength=minlength))
+
+
+@register("histogram", differentiable=False)
+def histogram(x, bins=10, range=None):
+    """Eager-only; returns (counts, bin_edges)."""
+    h, e = np.histogram(np.asarray(x), bins=bins, range=range)
+    return jnp.asarray(h), jnp.asarray(e)
+
+
+@register("setdiff1d", differentiable=False)
+def setdiff1d(a, b):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.setdiff1d(np.asarray(a), np.asarray(b)))
+
+
+@register("intersect1d", differentiable=False)
+def intersect1d(a, b):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.intersect1d(np.asarray(a), np.asarray(b)))
+
+
+@register("union1d", differentiable=False)
+def union1d(a, b):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.union1d(np.asarray(a), np.asarray(b)))
+
+
+@register("isin", differentiable=False)
+def isin(x, test_elements):
+    return jnp.isin(x, test_elements)
+
+
+@register("interp")
+def interp(x, xp, fp):
+    return jnp.interp(x, xp, fp)
+
+
+@register("clip_by_global_norm")
+def clip_by_global_norm(*arrays, max_norm=1.0):
+    """Utility parity with gluon.utils.clip_global_norm as an op: scales
+    every array by min(1, max_norm/global_norm)."""
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a.astype(jnp.float32)))
+                         for a in arrays))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    out = tuple(a * scale.astype(a.dtype) for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,158 @@
+"""numpy-parity op wave + mx.np / mx.npx front (reference MXNet 2.x
+``mx.np``/``mx.npx``, SURVEY.md §2.2 ndarray row). numpy is the oracle."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+
+rs = np.random.RandomState(0)
+
+
+def _chk(got, want, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(got.asnumpy()), want,
+                               rtol=rtol, atol=atol)
+
+
+# (op call on nd, numpy oracle) pairs over shared inputs
+A = rs.rand(3, 4).astype(np.float32) + 0.5
+B = rs.rand(3, 4).astype(np.float32) + 0.5
+V = rs.rand(7).astype(np.float32)
+M = rs.rand(4, 4).astype(np.float32)
+
+CASES = [
+    ("exp2", lambda: nd.exp2(nd.array(A)), lambda: np.exp2(A)),
+    ("logaddexp", lambda: nd.logaddexp(nd.array(A), nd.array(B)),
+     lambda: np.logaddexp(A, B)),
+    ("copysign", lambda: nd.copysign(nd.array(A), nd.array(B - 1.0)),
+     lambda: np.copysign(A, B - 1.0)),
+    ("fmod", lambda: nd.fmod(nd.array(A), nd.array(B)),
+     lambda: np.fmod(A, B)),
+    ("floor_divide", lambda: nd.floor_divide(nd.array(A * 5),
+                                             nd.array(B + 0.5)),
+     lambda: np.floor_divide(A * 5, B + 0.5)),
+    ("std", lambda: nd.std(nd.array(A), axis=1),
+     lambda: A.std(axis=1)),
+    ("var_ddof", lambda: nd.var(nd.array(A), axis=0, ddof=1),
+     lambda: A.var(axis=0, ddof=1)),
+    ("average_w", lambda: nd.average(nd.array(A), axis=1,
+                                     weights=np.arange(4.0)),
+     lambda: np.average(A, axis=1, weights=np.arange(4.0))),
+    ("median", lambda: nd.median(nd.array(A), axis=1),
+     lambda: np.median(A, axis=1)),
+    ("percentile", lambda: nd.percentile(nd.array(A), q=30.0),
+     lambda: np.percentile(A, 30.0)),
+    ("ptp", lambda: nd.ptp(nd.array(A), axis=0), lambda: np.ptp(A, axis=0)),
+    ("cumprod", lambda: nd.cumprod(nd.array(A), axis=1),
+     lambda: np.cumprod(A, axis=1)),
+    ("nanmean", lambda: nd.nanmean(nd.array(A)), lambda: np.nanmean(A)),
+    ("roll", lambda: nd.roll(nd.array(A), shift=2, axis=1),
+     lambda: np.roll(A, 2, axis=1)),
+    ("rot90", lambda: nd.rot90(nd.array(A)), lambda: np.rot90(A)),
+    ("tril", lambda: nd.tril(nd.array(M)), lambda: np.tril(M)),
+    ("triu_k", lambda: nd.triu(nd.array(M), k=1), lambda: np.triu(M, 1)),
+    ("trace", lambda: nd.trace_op(nd.array(M)), lambda: np.trace(M)),
+    ("flipud", lambda: nd.flipud(nd.array(A)), lambda: np.flipud(A)),
+    ("moveaxis", lambda: nd.moveaxis(nd.array(A), source=0, destination=1),
+     lambda: np.moveaxis(A, 0, 1)),
+    ("diff", lambda: nd.diff(nd.array(A), axis=1),
+     lambda: np.diff(A, axis=1)),
+    ("kron", lambda: nd.kron(nd.array(A[:2, :2]), nd.array(M[:2, :2])),
+     lambda: np.kron(A[:2, :2], M[:2, :2])),
+    ("outer", lambda: nd.outer(nd.array(V), nd.array(V)),
+     lambda: np.outer(V, V)),
+    ("inner", lambda: nd.inner(nd.array(A), nd.array(B)),
+     lambda: np.inner(A, B)),
+    ("vdot", lambda: nd.vdot(nd.array(A), nd.array(B)),
+     lambda: np.vdot(A, B)),
+    ("tensordot", lambda: nd.tensordot(nd.array(A), nd.array(A.T), axes=1),
+     lambda: np.tensordot(A, A.T, axes=1)),
+    ("cross", lambda: nd.cross(nd.array(A[:, :3]), nd.array(B[:, :3])),
+     lambda: np.cross(A[:, :3], B[:, :3])),
+    ("polyval", lambda: nd.polyval(nd.array(V[:3]), nd.array(A)),
+     lambda: np.polyval(V[:3], A)),
+    ("trapz", lambda: nd.trapz(nd.array(V)), lambda: np.trapezoid(V)),
+    ("convolve", lambda: nd.convolve(nd.array(V), nd.array(V[:3])),
+     lambda: np.convolve(V, V[:3])),
+    ("searchsorted", lambda: nd.searchsorted(nd.array(np.sort(V)),
+                                             nd.array(A.ravel())),
+     lambda: np.searchsorted(np.sort(V), A.ravel())),
+    ("vander", lambda: nd.vander(nd.array(V), n=3),
+     lambda: np.vander(V, 3)),
+    ("sinc", lambda: nd.sinc(nd.array(A)), lambda: np.sinc(A)),
+    ("heaviside", lambda: nd.heaviside(nd.array(A - 1.0), nd.array(B)),
+     lambda: np.heaviside(A - 1.0, B)),
+]
+
+
+@pytest.mark.parametrize("name,got,want", CASES,
+                         ids=[c[0] for c in CASES])
+def test_numpy_wave_oracle(name, got, want):
+    w = np.asarray(want())
+    _chk(got(), w, rtol=2e-4, atol=2e-5)
+
+
+def test_dynamic_shape_eager_ops():
+    x = nd.array(np.array([3, 1, 3, 2, 1], np.float32))
+    np.testing.assert_array_equal(nd.unique(x).asnumpy(), [1, 2, 3])
+    nz = nd.nonzero(nd.array(np.array([[1, 0], [0, 2]], np.float32)))
+    np.testing.assert_array_equal(nz[0].asnumpy(), [0, 1])
+    np.testing.assert_array_equal(nz[1].asnumpy(), [0, 1])
+    bc = nd.bincount(nd.array(np.array([0, 1, 1, 3], np.float32)))
+    np.testing.assert_array_equal(bc.asnumpy(), [1, 2, 0, 1])
+    h, e = nd.histogram(nd.array(np.arange(10, dtype=np.float32)), bins=5)
+    np.testing.assert_array_equal(h.asnumpy(), [2, 2, 2, 2, 2])
+    np.testing.assert_array_equal(
+        nd.intersect1d(x, nd.array(np.array([2, 3], np.float32))).asnumpy(),
+        [2, 3])
+
+
+def test_numpy_wave_autograd():
+    """Differentiable wave ops participate in the tape."""
+    x = mx.nd.array(A)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.logaddexp(x, mx.nd.array(B))
+        z = nd.tril(y).sum()
+    z.backward()
+    g = x.grad.asnumpy()
+    want = np.tril(1.0 / (1.0 + np.exp(B - A)))
+    np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
+
+
+def test_mx_np_namespace():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(mx.np.add(a, a).asnumpy(),
+                               [[2, 4], [6, 8]])
+    np.testing.assert_allclose(
+        mx.np.einsum("ij,jk->ik", a, a).asnumpy(), [[7, 10], [15, 22]])
+    np.testing.assert_allclose(
+        mx.np.concatenate([a, a], axis=0).asnumpy().shape, (4, 2))
+    np.testing.assert_allclose(mx.np.linspace(0, 1, 5).asnumpy(),
+                               np.linspace(0, 1, 5))
+    assert mx.np.full_like(a, 7.0).asnumpy().tolist() == [[7, 7], [7, 7]]
+    g = mx.np.meshgrid(mx.np.arange(3), mx.np.arange(2))
+    assert g[0].shape == (2, 3)
+    s = mx.np.random.randn(3, 2)
+    assert s.shape == (3, 2)
+    assert isinstance(a, mx.np.ndarray)
+
+
+def test_mx_npx_namespace():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    sm = mx.npx.softmax(a).asnumpy()
+    np.testing.assert_allclose(sm.sum(axis=-1), [1.0, 1.0], rtol=1e-6)
+    mx.npx.set_np()
+    assert mx.npx.is_np_array()
+    mx.npx.reset_np()
+    assert not mx.npx.is_np_array()
+
+
+def test_clip_by_global_norm_op():
+    a = nd.array(np.ones((4,), np.float32) * 3.0)
+    b = nd.array(np.ones((2,), np.float32) * 4.0)
+    out_a, out_b = nd.clip_by_global_norm(a, b, max_norm=1.0)
+    total = np.sqrt((out_a.asnumpy() ** 2).sum() +
+                    (out_b.asnumpy() ** 2).sum())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
