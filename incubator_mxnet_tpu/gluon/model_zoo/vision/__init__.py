@@ -2,7 +2,7 @@
 
 Capability parity with reference ``python/mxnet/gluon/model_zoo/vision/``:
 ResNet v1/v2 (18/34/50/101/152), VGG(+BN), AlexNet, SqueezeNet, DenseNet,
-MobileNet v1/v2, Inception V3, and the ``get_model`` registry. ``pretrained=True`` is
+MobileNet v1/v2/v3, Inception V3, and the ``get_model`` registry. ``pretrained=True`` is
 gated (no network egress in this environment) — weights load from a local
 root when present.
 
@@ -13,9 +13,11 @@ compiles them end-to-end, NCHW layout with XLA retiling for the MXU.
 from .alexnet import AlexNet, alexnet
 from .densenet import (DenseNet, densenet121, densenet161, densenet169,
                        densenet201)
-from .mobilenet import (MobileNet, MobileNetV2, mobilenet0_25, mobilenet0_5,
+from .mobilenet import (MobileNet, MobileNetV2, MobileNetV3,
+                        mobilenet0_25, mobilenet0_5,
                         mobilenet0_75, mobilenet1_0, mobilenet_v2_0_25,
-                        mobilenet_v2_0_5, mobilenet_v2_0_75, mobilenet_v2_1_0)
+                        mobilenet_v2_0_5, mobilenet_v2_0_75, mobilenet_v2_1_0,
+                        mobilenet_v3_large, mobilenet_v3_small)
 from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
                      ResNetV1, ResNetV2, get_resnet, resnet18_v1, resnet18_v2,
                      resnet34_v1, resnet34_v2, resnet50_v1, resnet50_v2,
@@ -44,6 +46,8 @@ _models = {
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "mobilenetv3_large": mobilenet_v3_large,
+    "mobilenetv3_small": mobilenet_v3_small,
 }
 
 

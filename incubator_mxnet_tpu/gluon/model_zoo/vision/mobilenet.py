@@ -151,3 +151,132 @@ def mobilenet_v2_0_5(**kw):
 
 def mobilenet_v2_0_25(**kw):
     return _v2(0.25, **kw)
+
+
+# --------------------------------------------------------------------------
+# MobileNet v3 (Howard 1905.02244; reference model_zoo MobileNet v3 row,
+# SURVEY.md §2.2 Gluon model zoo). Adds squeeze-excite blocks and
+# hard-swish; "small" and "large" configurations.
+
+class _HardSigmoid(HybridBlock):
+    def forward(self, x, *args):
+        return (x + 3.0).clip(0.0, 6.0) / 6.0
+
+
+class _HardSwish(HybridBlock):
+    def forward(self, x, *args):
+        return x * ((x + 3.0).clip(0.0, 6.0) / 6.0)
+
+
+class _SE(HybridBlock):
+    """Squeeze-and-excite with hard-sigmoid gating (v3 flavor)."""
+
+    def __init__(self, channels, reduction=4, **kwargs):
+        super().__init__(**kwargs)
+        squeeze = max(1, channels // reduction)
+        with self.name_scope():
+            self.pool = GlobalAvgPool2D()
+            self.fc1 = Conv2D(squeeze, 1, use_bias=True)
+            self.fc2 = Conv2D(channels, 1, use_bias=True)
+            self.gate = _HardSigmoid()
+
+    def forward(self, x, *args):
+        w = self.pool(x).reshape((x.shape[0], -1, 1, 1))
+        w = self.fc1(w)
+        w = w.relu()
+        w = self.gate(self.fc2(w))
+        return x * w
+
+
+class _V3Bottleneck(HybridBlock):
+    def __init__(self, in_channels, exp, channels, kernel, stride, se,
+                 act, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        act_block = _HardSwish if act == "hswish" else None
+        with self.name_scope():
+            self.out = HybridSequential(prefix="")
+            if exp != in_channels:
+                self.out.add(Conv2D(exp, 1, use_bias=False), BatchNorm())
+                self.out.add(act_block() if act_block else
+                             Activation("relu"))
+            self.out.add(Conv2D(exp, kernel, stride, kernel // 2,
+                                groups=exp, use_bias=False), BatchNorm())
+            self.out.add(act_block() if act_block else Activation("relu"))
+            if se:
+                self.out.add(_SE(exp))
+            self.out.add(Conv2D(channels, 1, use_bias=False), BatchNorm())
+
+    def forward(self, x, *args):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+# (kernel, exp, out, SE, activation, stride)
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+class MobileNetV3(HybridBlock):
+    def __init__(self, mode="large", multiplier=1.0, classes=1000,
+                 **kwargs):
+        super().__init__(**kwargs)
+        cfg = _V3_LARGE if mode == "large" else _V3_SMALL
+        last_exp = 960 if mode == "large" else 576
+        last_ch = 1280 if mode == "large" else 1024
+
+        def _c(v):
+            return max(8, int(v * multiplier))
+
+        with self.name_scope():
+            self.features = HybridSequential(prefix="")
+            self.features.add(Conv2D(_c(16), 3, 2, 1, use_bias=False),
+                              BatchNorm(), _HardSwish())
+            in_ch = _c(16)
+            for k, exp, out_ch, se, act, stride in cfg:
+                self.features.add(_V3Bottleneck(
+                    in_ch, _c(exp), _c(out_ch), k, stride, se, act))
+                in_ch = _c(out_ch)
+            self.features.add(Conv2D(_c(last_exp), 1, use_bias=False),
+                              BatchNorm(), _HardSwish())
+            self.features.add(GlobalAvgPool2D())
+            self.output = HybridSequential(prefix="output_")
+            self.output.add(Flatten(),
+                            Dense(last_ch, in_units=_c(last_exp)),
+                            _HardSwish(),
+                            Dense(classes, in_units=last_ch))
+
+    def forward(self, x, *args):
+        return self.output(self.features(x))
+
+
+def _v3(mode, pretrained=False, ctx=None, root=None, **kwargs):
+    if pretrained:
+        raise RuntimeError("no network egress; load weights manually")
+    return MobileNetV3(mode=mode, **kwargs)
+
+
+def mobilenet_v3_large(**kw):
+    return _v3("large", **kw)
+
+
+def mobilenet_v3_small(**kw):
+    return _v3("small", **kw)

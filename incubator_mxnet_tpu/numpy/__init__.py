@@ -86,7 +86,7 @@ _PASSTHROUGH = [
     "unique", "nonzero", "flatnonzero", "argwhere", "bincount",
     "histogram", "setdiff1d", "intersect1d", "union1d", "isin", "interp",
     # misc
-    "interp", "gather_nd",
+    "gather_nd",
 ]
 
 for _np_name in _PASSTHROUGH:
@@ -99,6 +99,122 @@ for _np_name, _target in _ALIASES.items():
     _fn = getattr(_nd, _target, None)
     if _fn is not None and not hasattr(_this, _np_name):
         setattr(_this, _np_name, _fn)
+
+
+# numpy's canonical call signatures are positional; the generic nd wrappers
+# are array-positional + keyword-options, so the ops whose numpy signature
+# takes non-array positionals get explicit shims here.
+
+def reshape(a, newshape, order="C"):
+    return a.reshape(newshape)
+
+
+def transpose(a, axes=None):
+    return _nd.transpose(a, axes=axes) if axes is not None else \
+        _nd.transpose(a)
+
+
+def expand_dims(a, axis):
+    return _nd.expand_dims(a, axis=axis)
+
+
+def squeeze(a, axis=None):
+    return _nd.squeeze(a, axis=axis) if axis is not None else _nd.squeeze(a)
+
+
+def clip(a, a_min, a_max):
+    return _nd.clip(a, a_min=a_min, a_max=a_max)
+
+
+def roll(a, shift, axis=None):
+    return _nd.roll(a, shift=shift, axis=axis)
+
+
+def rot90(a, k=1, axes=(0, 1)):
+    return _nd.rot90(a, k=k, axes=axes)
+
+
+def moveaxis(a, source, destination):
+    return _nd.moveaxis(a, source=source, destination=destination)
+
+
+def rollaxis(a, axis, start=0):
+    return _nd.rollaxis(a, axis=axis, start=start)
+
+
+def repeat(a, repeats, axis=None):
+    return _nd.repeat(a, repeats=repeats, axis=axis)
+
+
+def tile(a, reps):
+    return _nd.tile(a, reps=reps)
+
+
+def flip(a, axis=None):
+    return _nd.flip(a, axis=axis)
+
+
+def split(a, indices_or_sections, axis=0):
+    # jnp.split accepts either a section count or split indices
+    return _nd.split(a, num_outputs=indices_or_sections, axis=axis)
+
+
+def take(a, indices, axis=None):
+    if axis is None:
+        return _nd.take(a.reshape(-1), indices, axis=0)
+    return _nd.take(a, indices, axis=axis)
+
+
+def quantile(a, q, axis=None, keepdims=False):
+    return _nd.quantile(a, q=q, axis=axis, keepdims=keepdims)
+
+
+def percentile(a, q, axis=None, keepdims=False):
+    return _nd.percentile(a, q=q, axis=axis, keepdims=keepdims)
+
+
+def tensordot(a, b, axes=2):
+    return _nd.tensordot(a, b, axes=axes)
+
+
+def partition(a, kth, axis=-1):
+    return _nd.partition_op(a, kth=kth, axis=axis)
+
+
+def argpartition(a, kth, axis=-1):
+    return _nd.argpartition(a, kth=kth, axis=axis)
+
+
+def resize(a, new_shape):
+    return _nd.resize_op(a, new_shape=new_shape)
+
+
+def cumsum(a, axis=None):
+    return _nd.cumsum(a, axis=axis)
+
+
+def cumprod(a, axis=None):
+    return _nd.cumprod(a, axis=axis)
+
+
+def diff(a, n=1, axis=-1):
+    return _nd.diff(a, n=n, axis=axis)
+
+
+def tril(m, k=0):
+    return _nd.tril(m, k=k)
+
+
+def triu(m, k=0):
+    return _nd.triu(m, k=k)
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _nd.trace_op(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def searchsorted(a, v, side="left"):
+    return _nd.searchsorted(a, v, side=side)
 
 
 def einsum(subscripts, *operands):

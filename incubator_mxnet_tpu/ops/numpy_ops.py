@@ -91,34 +91,42 @@ def fabs(x):
     return jnp.abs(x)
 
 
+def _as_bitwise(x):
+    """bool and integer dtypes pass through (numpy semantics); floats are
+    a user error numpy also rejects — cast to int32 for leniency."""
+    if jnp.issubdtype(x.dtype, jnp.bool_) or             jnp.issubdtype(x.dtype, jnp.integer):
+        return x
+    return x.astype(jnp.int32)
+
+
 @register("invert", aliases=("bitwise_not",), differentiable=False)
 def invert(x):
-    return jnp.invert(x.astype(jnp.int32))
+    return jnp.invert(_as_bitwise(x))
 
 
 @register("bitwise_and", differentiable=False)
 def bitwise_and(a, b):
-    return jnp.bitwise_and(a.astype(jnp.int32), b.astype(jnp.int32))
+    return jnp.bitwise_and(_as_bitwise(a), _as_bitwise(b))
 
 
 @register("bitwise_or", differentiable=False)
 def bitwise_or(a, b):
-    return jnp.bitwise_or(a.astype(jnp.int32), b.astype(jnp.int32))
+    return jnp.bitwise_or(_as_bitwise(a), _as_bitwise(b))
 
 
 @register("bitwise_xor", differentiable=False)
 def bitwise_xor(a, b):
-    return jnp.bitwise_xor(a.astype(jnp.int32), b.astype(jnp.int32))
+    return jnp.bitwise_xor(_as_bitwise(a), _as_bitwise(b))
 
 
 @register("left_shift", differentiable=False)
 def left_shift(a, b):
-    return jnp.left_shift(a.astype(jnp.int32), b.astype(jnp.int32))
+    return jnp.left_shift(_as_bitwise(a), _as_bitwise(b))
 
 
 @register("right_shift", differentiable=False)
 def right_shift(a, b):
-    return jnp.right_shift(a.astype(jnp.int32), b.astype(jnp.int32))
+    return jnp.right_shift(_as_bitwise(a), _as_bitwise(b))
 
 
 # --- reductions / statistics -------------------------------------------------

@@ -78,6 +78,54 @@ def main() -> int:
     # multi-device per process: a real (processes x local-devices) topology
     assert len(jax.local_devices()) >= 4, jax.local_devices()
 
+    # ---- sparse dist push/pull: row_sparse gradient aggregation -----------
+    kv3 = mx.kvstore.create("dist_sync")
+    kv3.init("emb", mx.nd.zeros((6, 2)))
+    dense = np.zeros((6, 2), np.float32)
+    dense[rank + 1] = rank + 1.0          # each rank touches one row
+    g = mx.nd.array(dense).tostype("row_sparse")
+    kv3.push("emb", g)
+    out3 = mx.nd.zeros((6, 2))
+    kv3.pull("emb", out=out3)
+    want = np.zeros((6, 2), np.float32)
+    for r in range(size):
+        want[r + 1] += r + 1.0
+    np.testing.assert_allclose(out3.asnumpy(), want)
+
+    # row_sparse_pull fills only the requested rows
+    rowed = mx.nd.zeros((6, 2)).tostype("row_sparse")
+    kv3.row_sparse_pull("emb", out=rowed, row_ids=mx.nd.array(
+        np.array([rank + 1], np.float32)))
+    np.testing.assert_allclose(
+        rowed.tostype("default").asnumpy()[rank + 1], want[rank + 1])
+
+    # ---- multi-host fused SPMD train step (global mesh DP) ----------------
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)          # identical init on every process
+    np.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"),
+            nn.Dense(2, in_units=8))
+    net.initialize(init="xavier")
+    gmesh = Mesh(devs.reshape(-1), ("data",))
+    st = parallel.SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 0.1}, mesh=gmesh,
+                              donate=False)
+    xg = np.random.RandomState(0).rand(n_dev * 2, 4).astype(np.float32)
+    yg = np.random.RandomState(1).rand(n_dev * 2, 2).astype(np.float32)
+    l0 = float(st.step(xg, yg))
+    l1 = float(st.step(xg, yg))
+    assert np.isfinite(l0) and l1 < l0, (l0, l1)
+    # every process must hold identical (replicated) updated params
+    from jax.experimental import multihost_utils
+
+    wsum = float(jnp.sum(st.params[list(st.params)[0]]))
+    sums = np.asarray(multihost_utils.process_allgather(
+        np.array([wsum], np.float32)))
+    np.testing.assert_allclose(sums, sums.reshape(-1)[0], rtol=1e-6)
+
     # ---- batched gradient path: MANY tensors, ONE compiled collective ----
     expect = sum(r + 1 for r in range(size))
     kv3 = mx.kvstore.create("dist_sync")

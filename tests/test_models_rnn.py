@@ -13,6 +13,7 @@ from incubator_mxnet_tpu.gluon.model_zoo import vision
 @pytest.mark.parametrize("name,size", [
     ("resnet18_v1", 224), ("resnet18_v2", 224), ("squeezenet1.1", 224),
     ("mobilenet0.25", 224), ("mobilenetv2_0.25", 224),
+    ("mobilenetv3_small", 224),
 ])
 def test_model_zoo_forward(name, size):
     net = vision.get_model(name, classes=10)

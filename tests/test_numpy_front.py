@@ -156,3 +156,39 @@ def test_clip_by_global_norm_op():
     total = np.sqrt((out_a.asnumpy() ** 2).sum() +
                     (out_b.asnumpy() ** 2).sum())
     np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_mx_np_positional_signatures():
+    """numpy's canonical positional call shapes must work on mx.np."""
+    a = mx.np.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert mx.np.reshape(a, (4, 3)).shape == (4, 3)
+    assert mx.np.transpose(a).shape == (4, 3)
+    assert mx.np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert mx.np.squeeze(mx.np.expand_dims(a, 0), 0).shape == (3, 4)
+    np.testing.assert_allclose(mx.np.clip(a, 2.0, 5.0).asnumpy(),
+                               np.clip(np.arange(12).reshape(3, 4), 2, 5))
+    np.testing.assert_allclose(mx.np.roll(a, 1).asnumpy(),
+                               np.roll(np.arange(12.).reshape(3, 4), 1))
+    assert mx.np.moveaxis(a, 0, 1).shape == (4, 3)
+    np.testing.assert_allclose(mx.np.repeat(a, 2, 1).shape, (3, 8))
+    assert mx.np.tile(a, (2, 1)).shape == (6, 4)
+    parts = mx.np.split(a, 2, 1)
+    assert parts[0].shape == (3, 2)
+    np.testing.assert_allclose(
+        float(mx.np.quantile(a, 0.5).asnumpy()),
+        np.quantile(np.arange(12.).reshape(3, 4), 0.5))
+    np.testing.assert_allclose(
+        float(mx.np.percentile(a, 30).asnumpy()),
+        np.percentile(np.arange(12.).reshape(3, 4), 30), rtol=1e-6)
+    assert mx.np.tensordot(a, mx.np.transpose(a), 1).shape == (3, 3)
+    assert mx.np.partition(a, 1).shape == (3, 4)
+    assert mx.np.resize(a, (2, 2)).shape == (2, 2)
+    np.testing.assert_allclose(
+        mx.np.take(a, mx.np.array([0, 5]).astype(np.int32)).asnumpy(),
+        [0.0, 5.0])
+    assert mx.np.trace(a).shape == ()
+    assert mx.np.flip(a, 1).shape == (3, 4)
+    # bool bitwise semantics (numpy): invert(bool) is logical not
+    b = mx.np.array(np.array([True, False]))
+    np.testing.assert_array_equal(mx.np.invert(b).asnumpy(),
+                                  [False, True])
