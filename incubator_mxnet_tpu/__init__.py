@@ -60,7 +60,7 @@ def __getattr__(name):
                 "kvstore", "metric", "io", "image", "recordio", "amp",
                 "profiler", "parallel", "symbol", "sym", "module", "mod",
                 "model", "executor", "model_zoo", "test_utils", "onnx",
-                "operator", "contrib", "np", "npx"):
+                "operator", "contrib", "np", "npx", "rtc"):
         import importlib
 
         mod = importlib.import_module(

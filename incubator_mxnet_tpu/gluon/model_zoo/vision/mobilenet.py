@@ -239,6 +239,8 @@ class MobileNetV3(HybridBlock):
     def __init__(self, mode="large", multiplier=1.0, classes=1000,
                  **kwargs):
         super().__init__(**kwargs)
+        if mode not in ("large", "small"):
+            raise ValueError(f"mode must be 'large' or 'small', got {mode!r}")
         cfg = _V3_LARGE if mode == "large" else _V3_SMALL
         last_exp = 960 if mode == "large" else 576
         last_ch = 1280 if mode == "large" else 1024

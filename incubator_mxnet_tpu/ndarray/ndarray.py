@@ -732,13 +732,14 @@ def eye(N, M=None, k=0, ctx=None, dtype=None) -> NDArray:
 def waitall() -> None:
     """Block until all async work completes (reference ``mx.nd.waitall``).
 
-    PJRT has no global barrier; effectively a no-op sync hint. Individual
-    arrays sync via ``wait_to_read``.
+    The reference's waitall is an exception sync point: async engine
+    failures surface here. PJRT raises async dispatch errors at the next
+    blocking call, so deferred errors from ``jax.effects_barrier`` are
+    re-raised (only the barrier API's absence is tolerated).
     """
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
 
 
 # ---------------------------------------------------------------------------

@@ -106,6 +106,8 @@ for _np_name, _target in _ALIASES.items():
 # takes non-array positionals get explicit shims here.
 
 def reshape(a, newshape, order="C"):
+    if order != "C":
+        raise NotImplementedError("only order='C' reshape is supported")
     return a.reshape(newshape)
 
 

@@ -1,0 +1,124 @@
+"""``mx.rtc`` — runtime custom-kernel authoring (reference
+``src/common/rtc.cc`` / ``python/mxnet/rtc.py`` ``CudaModule``).
+
+The reference compiles CUDA C at runtime with NVRTC and launches the
+kernels on NDArrays. The TPU-native equivalent is **Pallas**: kernels are
+authored as Python functions over ``Ref``s, compiled by Mosaic to native
+TPU code, and launched on NDArrays through the same ``invoke`` path as
+every framework op (autograd-visible, naive-engine aware).
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def scale_kernel(x_ref, o_ref, *, factor):
+        o_ref[...] = x_ref[...] * factor
+
+    mod = mx.rtc.PallasModule()
+    scale = mod.get_kernel(scale_kernel, out_like=0, factor=2.5)
+    y = scale(x)                      # NDArray in, NDArray out
+
+``CudaModule`` remains as an explicit unsupported stub: there is no CUDA
+on this backend, and silently accepting CUDA C would be a lie.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from .ndarray import NDArray, invoke
+
+
+class CudaModule:
+    """Unsupported on the TPU backend (reference ``mx.rtc.CudaModule``).
+
+    Raises immediately: CUDA C source cannot target this hardware. Port
+    the kernel to Pallas and use :class:`PallasModule` — the authoring
+    model is a Python function over memory references, the compiled
+    artifact is native Mosaic/TPU code.
+    """
+
+    def __init__(self, *args, **kwargs):
+        raise RuntimeError(
+            "mx.rtc.CudaModule requires a CUDA backend; this framework "
+            "targets TPU. Use mx.rtc.PallasModule (see its docstring) to "
+            "author custom TPU kernels in Pallas.")
+
+
+class PallasKernel:
+    """A launched-on-demand Pallas kernel over NDArrays."""
+
+    def __init__(self, kernel_fn: Callable, *, out_like: int = 0,
+                 out_shape: Optional[tuple] = None,
+                 out_dtype: Optional[Any] = None,
+                 grid: Optional[tuple] = None,
+                 interpret: Optional[bool] = None,
+                 name: Optional[str] = None, **kernel_kwargs):
+        self._kernel = kernel_fn
+        self._out_like = out_like
+        self._out_shape = out_shape
+        self._out_dtype = out_dtype
+        self._grid = grid
+        self._interpret = interpret
+        self._kwargs = kernel_kwargs
+        self.name = name or getattr(kernel_fn, "__name__", "pallas_kernel")
+        self._cached_fn = None
+
+    def _launch_fn(self):
+        import functools
+
+        import jax
+        from jax.experimental import pallas as pl
+
+        kernel = self._kernel
+        if self._kwargs:
+            kernel = functools.partial(kernel, **self._kwargs)
+        interpret = self._interpret
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        out_like, out_shape, out_dtype = (self._out_like, self._out_shape,
+                                          self._out_dtype)
+        grid = self._grid
+
+        def fn(*arrays):
+            if out_shape is not None:
+                shape = out_shape
+            else:
+                shape = arrays[out_like].shape
+            dtype = out_dtype or arrays[out_like].dtype
+            kw = {} if grid is None else {"grid": grid}
+            call = pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(shape, dtype),
+                interpret=interpret, **kw)
+            return call(*arrays)
+
+        return fn
+
+    def launch(self, args: Sequence[Any]):
+        """Reference ``CudaKernel.launch`` shape (args list); grid/block
+        come from the kernel definition, not the launch site — Mosaic owns
+        scheduling."""
+        return self(*args)
+
+    def __call__(self, *args) -> NDArray:
+        # stable function identity -> jax compile cache hits across launches
+        if self._cached_fn is None:
+            self._cached_fn = self._launch_fn()
+        return invoke(self._cached_fn, list(args), name=f"rtc.{self.name}",
+                      differentiable=False)
+
+
+class PallasModule:
+    """Factory for :class:`PallasKernel` (the ``CudaModule`` analog; a
+    module groups kernels only for API familiarity — Pallas kernels are
+    standalone)."""
+
+    def __init__(self, source: Optional[str] = None):
+        if source is not None:
+            raise RuntimeError(
+                "PallasModule takes no source string: author kernels as "
+                "Python functions over pallas Refs and pass them to "
+                "get_kernel()")
+
+    def get_kernel(self, kernel_fn: Callable, **options) -> PallasKernel:
+        return PallasKernel(kernel_fn, **options)

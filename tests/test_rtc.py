@@ -1,0 +1,46 @@
+"""mx.rtc — runtime custom-kernel authoring (reference mx.rtc.CudaModule,
+src/common/rtc.cc; TPU-native analog = Pallas, see rtc.py docstring)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _scale_kernel(x_ref, o_ref, *, factor):
+    o_ref[...] = x_ref[...] * factor
+
+
+def _saxpy_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = a_ref[...] * 2.0 + b_ref[...]
+
+
+def test_pallas_kernel_basic():
+    mod = mx.rtc.PallasModule()
+    scale = mod.get_kernel(_scale_kernel, factor=2.5)
+    x = mx.nd.array(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(scale(x).asnumpy(), np.arange(8) * 2.5)
+    # reference CudaKernel.launch(args) shape
+    np.testing.assert_allclose(scale.launch([x]).asnumpy(),
+                               np.arange(8) * 2.5)
+
+
+def test_pallas_kernel_multi_input():
+    k = mx.rtc.PallasModule().get_kernel(_saxpy_kernel)
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    b = mx.nd.array(np.full((4, 4), 3.0, np.float32))
+    np.testing.assert_allclose(k(a, b).asnumpy(), np.full((4, 4), 5.0))
+
+
+def test_pallas_kernel_explicit_out_shape():
+    def first_row(x_ref, o_ref):
+        o_ref[...] = x_ref[0, :]
+
+    k = mx.rtc.PallasModule().get_kernel(first_row, out_shape=(4,))
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(k(x).asnumpy(), [0, 1, 2, 3])
+
+
+def test_cuda_module_raises_with_guidance():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
