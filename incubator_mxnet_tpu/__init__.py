@@ -30,6 +30,7 @@ _jax.config.update("jax_default_matmul_precision", "highest")
 from . import base
 from . import config as _config_mod
 from .config import config
+_config_mod.apply_debug_nans()
 from .device import (Context, Device, cpu, cpu_pinned, cpu_shared,
                      current_context, gpu, gpu_memory_info, num_gpus,
                      num_tpus, tpu)

@@ -141,6 +141,22 @@ config.register(
     "(reference cuBLAS fp32 parity).")
 
 
+config.register(
+    "MXTPU_DEBUG_NANS", False, _parse_bool,
+    "Debug mode: raise at the first NaN/Inf produced by any computation "
+    "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "
+    "naive-engine + MXNET_ENGINE_TYPE debugging tier. Heavy: disables "
+    "async dispatch wins; use for fault isolation only.")
+
+
+def apply_debug_nans() -> None:
+    """Sync the jax_debug_nans flag with the knob (called at import and
+    settable at runtime via config.set + this function)."""
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(config.get("MXTPU_DEBUG_NANS")))
+
+
 def matmul_precision_for(dtypes) -> str:
     """Resolve the trace-time matmul precision for a compiled step given
     the parameter dtypes involved."""
