@@ -79,6 +79,11 @@ _UNARY = [
     "np_resize", "vander", "unique", "nonzero", "flatnonzero", "argwhere",
     "bincount", "histogram", "partition_op", "np_partition",
     "argpartition", "atleast_2d", "atleast_3d", "lexsort",
+    # fft/complex wave (ops/fft_ops.py)
+    "fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn", "ifftn",
+    "fftshift", "ifftshift", "real", "imag", "conj", "angle",
+    "linalg_norm", "linalg_cholesky", "linalg_eigvalsh", "linalg_pinv",
+    "linalg_matrix_rank", "linalg_matrix_power", "linalg_cond",
 ]
 _BINARY = [
     "elemwise_add", "broadcast_add", "add", "elemwise_sub", "broadcast_sub",
@@ -102,7 +107,7 @@ _BINARY = [
     "isclose", "array_equal", "kron", "outer", "inner", "vdot",
     "tensordot", "cross", "polyval", "trapz", "convolve", "correlate",
     "searchsorted", "digitize", "setdiff1d", "intersect1d", "union1d",
-    "isin",
+    "isin", "linalg_solve", "linalg_tensorsolve",
 ]
 _TERNARY = ["where", "scatter_nd", "interp"]
 _VARIADIC = ["concat", "concatenate", "stack", "khatri_rao",

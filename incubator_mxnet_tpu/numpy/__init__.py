@@ -285,3 +285,5 @@ bool_ = _onp.bool_
 
 
 from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import fft  # noqa: E402,F401

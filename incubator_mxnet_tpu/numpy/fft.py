@@ -1,0 +1,46 @@
+"""``mx.np.fft`` — numpy-style FFT namespace (reference contrib fft ops /
+numpy fft parity). XLA lowers these to the TPU-native FFT."""
+
+from __future__ import annotations
+
+from .. import ndarray as _nd
+
+
+def fft(a, n=None, axis=-1, norm=None):
+    return _nd.invoke_op("fft", a, n=n, axis=axis, norm=norm)
+
+
+def ifft(a, n=None, axis=-1, norm=None):
+    return _nd.invoke_op("ifft", a, n=n, axis=axis, norm=norm)
+
+
+def rfft(a, n=None, axis=-1, norm=None):
+    return _nd.invoke_op("rfft", a, n=n, axis=axis, norm=norm)
+
+
+def irfft(a, n=None, axis=-1, norm=None):
+    return _nd.invoke_op("irfft", a, n=n, axis=axis, norm=norm)
+
+
+def fft2(a, axes=(-2, -1), norm=None):
+    return _nd.invoke_op("fft2", a, axes=axes, norm=norm)
+
+
+def ifft2(a, axes=(-2, -1), norm=None):
+    return _nd.invoke_op("ifft2", a, axes=axes, norm=norm)
+
+
+def fftn(a, axes=None, norm=None):
+    return _nd.invoke_op("fftn", a, axes=axes, norm=norm)
+
+
+def ifftn(a, axes=None, norm=None):
+    return _nd.invoke_op("ifftn", a, axes=axes, norm=norm)
+
+
+def fftshift(a, axes=None):
+    return _nd.invoke_op("fftshift", a, axes=axes)
+
+
+def ifftshift(a, axes=None):
+    return _nd.invoke_op("ifftshift", a, axes=axes)

@@ -12,4 +12,5 @@ from . import pallas_attention  # noqa: F401  (registers flash_attention)
 from . import optimizer_ops  # noqa: F401  (registers update ops)
 from . import more  # noqa: F401  (registers samplers/image/misc ops)
 from . import moe   # noqa: F401  (registers mixture-of-experts ops)
+from . import fft_ops  # noqa: F401  (registers fft + np.linalg family)
 from .registry import get, list_ops, register  # noqa: F401

@@ -325,7 +325,7 @@ def test_debug_nans_knob():
             (mx.nd.array(np.array([0.0])) / mx.nd.array(
                 np.array([0.0]))).asnumpy()
     finally:
-        config.set("MXTPU_DEBUG_NANS", False)
+        config.unset("MXTPU_DEBUG_NANS")
         apply_debug_nans()
     # back to silent-NaN default
     out = (mx.nd.array(np.array([0.0])) / mx.nd.array(

@@ -192,3 +192,73 @@ def test_mx_np_positional_signatures():
     b = mx.np.array(np.array([True, False]))
     np.testing.assert_array_equal(mx.np.invert(b).asnumpy(),
                                   [False, True])
+
+
+def test_np_linalg_namespace():
+    a_np = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)  # SPD
+    a = mx.np.array(a_np)
+    np.testing.assert_allclose(mx.np.linalg.det(a).asnumpy(),
+                               np.linalg.det(a_np), rtol=1e-5)
+    np.testing.assert_allclose(mx.np.linalg.inv(a).asnumpy(),
+                               np.linalg.inv(a_np), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        mx.np.linalg.solve(a, mx.np.array([1.0, 2.0])).asnumpy(),
+        np.linalg.solve(a_np, [1.0, 2.0]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mx.np.linalg.cholesky(a).asnumpy(),
+                               np.linalg.cholesky(a_np), rtol=1e-4,
+                               atol=1e-5)
+    w, v = mx.np.linalg.eigh(a)
+    wn, _ = np.linalg.eigh(a_np)
+    np.testing.assert_allclose(w.asnumpy(), wn, rtol=1e-4, atol=1e-5)
+    q, r = mx.np.linalg.qr(a)
+    np.testing.assert_allclose((q.asnumpy() @ r.asnumpy()), a_np,
+                               rtol=1e-4, atol=1e-5)
+    u, s, vh = mx.np.linalg.svd(a)
+    np.testing.assert_allclose(
+        u.asnumpy() @ np.diag(s.asnumpy()) @ vh.asnumpy(), a_np,
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        float(mx.np.linalg.norm(a).asnumpy()), np.linalg.norm(a_np),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.np.linalg.matrix_power(a, 3).asnumpy(),
+        np.linalg.matrix_power(a_np, 3), rtol=1e-4)
+    assert int(mx.np.linalg.matrix_rank(a).asnumpy()) == 2
+
+
+def test_np_fft_roundtrip():
+    x_np = rs.rand(8, 16).astype(np.float32)
+    x = mx.np.array(x_np)
+    f = mx.np.fft.fft(x)
+    np.testing.assert_allclose(f.asnumpy(), np.fft.fft(x_np),
+                               rtol=1e-4, atol=1e-4)
+    back = mx.np.fft.ifft(f)
+    np.testing.assert_allclose(back.asnumpy().real, x_np, rtol=1e-4,
+                               atol=1e-5)
+    rf = mx.np.fft.rfft(x)
+    np.testing.assert_allclose(rf.asnumpy(), np.fft.rfft(x_np),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(mx.np.fft.irfft(rf, n=16).asnumpy(), x_np,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        mx.np.fft.fftshift(x).asnumpy(), np.fft.fftshift(x_np))
+    # real/imag/conj/angle surface
+    np.testing.assert_allclose(nd.real(f).asnumpy(), np.fft.fft(x_np).real,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(nd.angle(f).asnumpy(),
+                               np.angle(np.fft.fft(x_np)), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_fft_gradient():
+    """FFT ops differentiate (jax lowers the adjoint FFT)."""
+    x = mx.nd.array(rs.rand(8).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.real(nd.invoke_op("fft", x)).sum()
+    y.backward()
+    # d/dx sum(Re(FFT(x))) = column sums of the real DFT matrix
+    W = np.fft.fft(np.eye(8))
+    want = W.real.sum(axis=0)
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-4,
+                               atol=1e-4)
