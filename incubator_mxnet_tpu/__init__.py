@@ -61,13 +61,16 @@ def __getattr__(name):
                 "kvstore", "metric", "io", "image", "recordio", "amp",
                 "profiler", "parallel", "symbol", "sym", "module", "mod",
                 "model", "executor", "model_zoo", "test_utils", "onnx",
-                "operator", "contrib", "np", "npx", "rtc"):
+                "operator", "contrib", "np", "npx", "rtc", "callback",
+                "monitor", "visualization", "viz", "name", "attribute",
+                "util", "engine", "registry"):
         import importlib
 
         mod = importlib.import_module(
             "." + {"sym": "symbol", "mod": "module",
                    "model_zoo": "gluon.model_zoo", "np": "numpy",
-                   "npx": "numpy_extension"}.get(name, name), __name__)
+                   "npx": "numpy_extension",
+                   "viz": "visualization"}.get(name, name), __name__)
         setattr(_sys.modules[__name__], name, mod)
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

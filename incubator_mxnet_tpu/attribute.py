@@ -1,0 +1,47 @@
+"""``mx.attribute.AttrScope`` — attach attributes to every Symbol created
+inside a ``with`` block (reference ``python/mxnet/attribute.py``; the
+reference uses it for ``__ctx_group__`` device grouping and lr_mult
+tagging)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _State()
+
+
+def current_attrs() -> Dict[str, str]:
+    """Merged attributes of the active AttrScope stack (inner wins)."""
+    merged: Dict[str, str] = {}
+    for scope in _state.stack:
+        merged.update(scope._attrs)
+    return merged
+
+
+class AttrScope:
+    def __init__(self, **attrs):
+        for v in attrs.values():
+            if not isinstance(v, str):
+                raise ValueError(
+                    "AttrScope values must be strings (reference semantics)")
+        self._attrs = attrs
+
+    def get(self, attrs=None):
+        merged = current_attrs()
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self):
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()

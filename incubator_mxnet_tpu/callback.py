@@ -1,0 +1,113 @@
+"""Training callbacks (reference ``python/mxnet/callback.py``): consumed
+by ``Module.fit``'s ``batch_end_callback``/``epoch_end_callback`` and
+usable from any custom loop. Callback params carry
+``(epoch, nbatch, eval_metric, locals)`` like the reference's
+``BatchEndParam``."""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import namedtuple
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+class Speedometer:
+    """Log training speed + metrics every ``frequent`` batches (reference
+    ``mx.callback.Speedometer``)."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        elapsed = time.time() - self.tic
+        speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+        if param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s" % (
+                param.epoch, count, speed,
+                "\t".join(f"{n}={v:.6f}" for n, v in name_value))
+        else:
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
+                param.epoch, count, speed)
+        logging.info(msg)
+        self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per epoch (reference ``mx.callback.ProgressBar``)."""
+
+    def __init__(self, total: int, length: int = 80):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        logging.info("[%s] %s%%", bar, pct)
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch-end callback saving module checkpoints (reference
+    ``mx.callback.do_checkpoint``); signature
+    ``(epoch, sym, arg_params, aux_params)``."""
+    period = int(max(1, period))
+
+    def _callback(epoch, sym=None, arg_params=None, aux_params=None):
+        if (epoch + 1) % period == 0:
+            from .model import save_checkpoint
+
+            save_checkpoint(prefix, epoch + 1, sym, arg_params or {},
+                            aux_params or {})
+
+    return _callback
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    """Batch-end callback logging the running metric every ``period``
+    batches (reference ``mx.callback.log_train_metric``)."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end callback logging validation metrics (reference class of
+    the same name)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

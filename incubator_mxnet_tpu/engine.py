@@ -1,0 +1,36 @@
+"""``mx.engine`` — engine control surface (reference
+``python/mxnet/engine.py``: ``bulk``/``set_bulk_size`` batch many small
+ops into one engine push to cut dispatch overhead).
+
+TPU-native: op bulking is what ``jit``/``hybridize`` do — XLA fuses the
+whole region into one executable — so ``bulk`` is an alias for "you want
+a compiled region". The knobs are kept for API compatibility: they store
+the requested size and document the mapping; the naive-engine switch
+(``MXTPU_ENGINE_TYPE=naive``, config.py) is the debugging analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_bulk_size = 15  # reference default MXNET_ENGINE_BULK_SIZE
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulking hint; returns the previous value (reference
+    signature). No-op beyond bookkeeping — see module docstring."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """``with mx.engine.bulk(n):`` — reference bulking scope. Here it is
+    a documentation-preserving alias: for real fusion, hybridize the
+    block or jit the step (XLA fuses the whole region)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,91 @@
+"""``mx.monitor.Monitor`` — layer-output statistics for debugging
+(reference ``python/mxnet/monitor.py``: installs a stat collector on every
+executor output and prints ``(name, stat)`` rows each ``interval``).
+
+Here the install targets are Gluon Blocks (forward hooks on every child)
+— the imperative world the debugging happens in. ``tic``/``toc``/
+``toc_print`` match the reference API.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+def _default_stat(x: np.ndarray) -> np.ndarray:
+    return np.asarray(np.abs(x).mean())
+
+
+class Monitor:
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        import re
+
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re = re.compile(pattern)
+        self.sort = sort
+        self.queue: List[Tuple[int, str, Any]] = []
+        self.step = 0
+        self.activated = False
+        self._handles: List[Any] = []
+
+    # -- install ------------------------------------------------------------
+    def install(self, block) -> None:
+        """Attach to a Block tree: records a stat for every child block
+        output while activated (reference ``Monitor.install`` on an
+        executor's outputs)."""
+
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            name = getattr(blk, "name", type(blk).__name__)
+            if not self.re.match(name):
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                try:
+                    arr = np.asarray(o.asnumpy())
+                except Exception:
+                    continue
+                suffix = f"_output{i}" if len(outs) > 1 else "_output"
+                self.queue.append(
+                    (self.step, name + suffix,
+                     np.asarray(self.stat_func(arr))))
+
+        for child in self._walk(block):
+            self._handles.append(child.register_forward_hook(hook))
+
+    def _walk(self, block):
+        yield block
+        for c in getattr(block, "_children", {}).values():
+            yield from self._walk(c)
+
+    # -- reference API --------------------------------------------------------
+    def tic(self) -> None:
+        """Start collecting for this step (reference semantics: collect
+        when step %% interval == 0)."""
+        if self.step % self.interval == 0:
+            self.activated = True
+        self.queue = []
+
+    def toc(self) -> List[Tuple[int, str, Any]]:
+        """Stop collecting; return (step, name, stat) rows."""
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda r: r[1])
+        self.queue = []
+        self.step += 1
+        return res
+
+    def toc_print(self) -> None:
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, str(stat))

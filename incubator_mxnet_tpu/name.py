@@ -1,0 +1,45 @@
+"""``mx.name`` — symbol auto-naming scopes (reference
+``python/mxnet/name.py``). The manager itself lives with the Symbol world
+(``symbol/symbol.py``); this module provides the reference's public
+surface: ``NameManager`` and the ``Prefix`` variant usable as context
+managers."""
+
+from __future__ import annotations
+
+from .symbol.symbol import _name_manager as _global_manager
+
+
+class NameManager:
+    """Context manager scoping auto-generated op names. Entering pushes a
+    fresh counter table; exiting restores the previous one (reference
+    ``mx.name.NameManager`` current-stack semantics)."""
+
+    def __init__(self):
+        self._saved = None
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        return _global_manager.get(hint)
+
+    def __enter__(self):
+        self._saved = dict(_global_manager._counters)
+        _global_manager._counters.clear()
+        return self
+
+    def __exit__(self, *exc):
+        _global_manager._counters.clear()
+        _global_manager._counters.update(self._saved)
+
+
+class Prefix(NameManager):
+    """NameManager that prepends ``prefix`` to every auto name (reference
+    ``mx.name.Prefix``)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

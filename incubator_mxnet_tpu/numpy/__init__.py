@@ -86,7 +86,7 @@ _PASSTHROUGH = [
     "unique", "nonzero", "flatnonzero", "argwhere", "bincount",
     "histogram", "setdiff1d", "intersect1d", "union1d", "isin", "interp",
     # misc
-    "gather_nd",
+    "gather_nd", "real", "imag", "conj", "angle",
 ]
 
 for _np_name in _PASSTHROUGH:

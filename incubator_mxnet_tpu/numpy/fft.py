@@ -22,20 +22,20 @@ def irfft(a, n=None, axis=-1, norm=None):
     return _nd.invoke_op("irfft", a, n=n, axis=axis, norm=norm)
 
 
-def fft2(a, axes=(-2, -1), norm=None):
-    return _nd.invoke_op("fft2", a, axes=axes, norm=norm)
+def fft2(a, s=None, axes=(-2, -1), norm=None):
+    return _nd.invoke_op("fft2", a, s=s, axes=axes, norm=norm)
 
 
-def ifft2(a, axes=(-2, -1), norm=None):
-    return _nd.invoke_op("ifft2", a, axes=axes, norm=norm)
+def ifft2(a, s=None, axes=(-2, -1), norm=None):
+    return _nd.invoke_op("ifft2", a, s=s, axes=axes, norm=norm)
 
 
-def fftn(a, axes=None, norm=None):
-    return _nd.invoke_op("fftn", a, axes=axes, norm=norm)
+def fftn(a, s=None, axes=None, norm=None):
+    return _nd.invoke_op("fftn", a, s=s, axes=axes, norm=norm)
 
 
-def ifftn(a, axes=None, norm=None):
-    return _nd.invoke_op("ifftn", a, axes=axes, norm=norm)
+def ifftn(a, s=None, axes=None, norm=None):
+    return _nd.invoke_op("ifftn", a, s=s, axes=axes, norm=norm)
 
 
 def fftshift(a, axes=None):

@@ -38,23 +38,23 @@ def irfft(x, n=None, axis=-1, norm=None):
 
 
 @register("fft2")
-def fft2(x, axes=(-2, -1), norm=None):
-    return jnp.fft.fft2(x, axes=tuple(axes), norm=norm)
+def fft2(x, s=None, axes=(-2, -1), norm=None):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=norm)
 
 
 @register("ifft2")
-def ifft2(x, axes=(-2, -1), norm=None):
-    return jnp.fft.ifft2(x, axes=tuple(axes), norm=norm)
+def ifft2(x, s=None, axes=(-2, -1), norm=None):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=norm)
 
 
 @register("fftn")
-def fftn(x, axes=None, norm=None):
-    return jnp.fft.fftn(x, axes=axes, norm=norm)
+def fftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
 
 
 @register("ifftn")
-def ifftn(x, axes=None, norm=None):
-    return jnp.fft.ifftn(x, axes=axes, norm=norm)
+def ifftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
 
 
 @register("fftshift")
@@ -106,29 +106,24 @@ def linalg_solve(a, b):
 
 @register("linalg_lstsq", differentiable=False)
 def linalg_lstsq(a, b, rcond=None):
-    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
-    return sol, res, rank, sv
+    return jnp.linalg.lstsq(a, b, rcond=rcond)
 
 
 @register("linalg_qr")
 def linalg_qr(a, mode="reduced"):
-    q, r = jnp.linalg.qr(a, mode=mode)
-    return q, r
+    # mode='r' returns a single array; 'reduced'/'complete' return (q, r)
+    return jnp.linalg.qr(a, mode=mode)
 
 
 @register("linalg_svd")
 def linalg_svd(a, full_matrices=True, compute_uv=True):
-    if not compute_uv:
-        return jnp.linalg.svd(a, full_matrices=full_matrices,
-                              compute_uv=False)
-    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-    return u, s, vh
+    return jnp.linalg.svd(a, full_matrices=full_matrices,
+                          compute_uv=compute_uv)
 
 
 @register("linalg_eigh")
 def linalg_eigh(a, UPLO="L"):
-    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
-    return w, v
+    return jnp.linalg.eigh(a, UPLO=UPLO)
 
 
 @register("linalg_eigvalsh")

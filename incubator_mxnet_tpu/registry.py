@@ -1,0 +1,65 @@
+"""``mx.registry`` — generic object registries (reference
+``python/mxnet/registry.py``: get_register_func/get_alias_func/
+get_create_func drive the ``Optimizer.register``/``create`` pattern)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Type
+
+_REGISTRIES: Dict[Type, Dict[str, Any]] = {}
+
+
+def _registry_of(base_class: Type) -> Dict[str, Any]:
+    return _REGISTRIES.setdefault(base_class, {})
+
+
+def get_register_func(base_class: Type, nickname: str) -> Callable:
+    """Returns a ``register(klass, name=None)`` decorator for subclasses
+    of ``base_class`` (reference semantics incl. lowercase keys and
+    re-registration warning)."""
+    registry = _registry_of(base_class)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), (
+            f"can only register subclasses of {base_class.__name__}")
+        key = (name or klass.__name__).lower()
+        if key in registry:
+            import warnings
+
+            warnings.warn(f"registry {nickname}: overriding {key}")
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class: Type, nickname: str) -> Callable:
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for a in aliases:
+                register(klass, a)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class: Type, nickname: str) -> Callable:
+    registry = _registry_of(base_class)
+
+    def create(name, *args, **kwargs):
+        if isinstance(name, base_class):
+            return name
+        key = str(name).lower()
+        if key not in registry:
+            raise ValueError(
+                f"unknown {nickname} {name!r}; registered: "
+                f"{sorted(registry)}")
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance by name"
+    return create

@@ -1,0 +1,71 @@
+"""``mx.util`` — misc utilities (reference ``python/mxnet/util.py``:
+numpy-semantics toggles and env helpers)."""
+
+from __future__ import annotations
+
+import functools
+
+
+def use_np_shape(func):
+    """Decorator parity (numpy shape semantics are native here)."""
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    """Reference ``mx.util.use_np`` — activates numpy semantics for the
+    wrapped callable; native behavior here, so identity."""
+    return func
+
+
+def is_np_shape() -> bool:
+    from . import numpy_extension as npx
+
+    return npx.is_np_shape()
+
+
+def is_np_array() -> bool:
+    from . import numpy_extension as npx
+
+    return npx.is_np_array()
+
+
+def set_np(shape=True, array=True, dtype=False) -> None:
+    from . import numpy_extension as npx
+
+    npx.set_np(shape=shape, array=array, dtype=dtype)
+
+
+def reset_np() -> None:
+    from . import numpy_extension as npx
+
+    npx.reset_np()
+
+
+def getenv(name: str):
+    """Runtime config read (reference ``mx.util.getenv`` over the C API's
+    MXGetEnv): consults the MXTPU knob registry first, then the process
+    environment."""
+    import os
+
+    from .config import config
+
+    try:
+        return config.get(name)
+    except KeyError:
+        return os.environ.get(name)
+
+
+def setenv(name: str, value) -> None:
+    """Runtime config write (reference ``mx.util.setenv``)."""
+    from .config import config
+
+    try:
+        config.set(name, value)
+    except KeyError:
+        import os
+
+        os.environ[name] = str(value)

@@ -1,0 +1,96 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``):
+``print_summary`` walks a Symbol DAG printing a Keras-style layer table
+with output shapes and parameter counts; ``plot_network`` renders with
+graphviz when available (gated — raises with guidance otherwise)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def print_summary(symbol, shape: Optional[Dict[str, Tuple]] = None,
+                  line_length: int = 120,
+                  positions=(0.44, 0.64, 0.74, 1.0)) -> None:
+    """Print a layer-by-layer summary of a Symbol graph (reference
+    ``mx.viz.print_summary``)."""
+    internals = symbol.get_internals()
+    shape_by_name: Dict[str, Tuple] = {}
+    if shape:
+        # internals is a group: out_shapes align with its entries
+        _, out_shapes, _ = internals.infer_shape_partial(**shape)
+        for (node, idx), os_ in zip(internals._entries, out_shapes):
+            if node.op is not None and os_ is not None and idx == 0:
+                shape_by_name[node.name] = tuple(os_)
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(values, pos):
+        line = ""
+        for v, p in zip(values, pos):
+            line = (line + str(v))[:p - 1].ljust(p)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+
+    total_params = 0
+    arg_shapes: Dict[str, Tuple] = {}
+    if shape:
+        args = symbol.list_arguments()
+        arg_sh, _, _ = symbol.infer_shape(**shape)
+        arg_shapes = dict(zip(args, arg_sh))
+
+    seen_params = set()
+    seen_nodes = set()
+    for entry in internals._entries:
+        node = entry[0]
+        if node.op is None or id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        out_shape = shape_by_name.get(node.name, "")
+        n_params = 0
+        prevs = []
+        for inp in node.inputs:
+            src = inp[0]
+            if src.op is None:  # variable: parameter or data input
+                nm = src.name
+                if shape and nm in arg_shapes and nm not in (shape or {}):
+                    if nm not in seen_params:
+                        n_params += int(np.prod(arg_shapes[nm])) \
+                            if arg_shapes[nm] else 0
+                        seen_params.add(nm)
+            else:
+                prevs.append(src.name)
+        total_params += n_params
+        print_row([f"{node.name} ({node.op})", out_shape, n_params,
+                   ",".join(prevs)], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title: str = "plot", shape=None,
+                 node_attrs=None, **kwargs):
+    """Render the Symbol DAG with graphviz (reference
+    ``mx.viz.plot_network``); raises with guidance when graphviz is not
+    installed (this image has no graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the graphviz package (not available in "
+            "this environment); use print_summary for a text rendering"
+        ) from e
+
+    dot = Digraph(name=title)
+    for entry in symbol.get_internals()._entries:
+        node = entry[0]
+        label = node.name if node.op is None else f"{node.name}\n{node.op}"
+        dot.node(node.name, label=label, **(node_attrs or {}))
+        for inp in node.inputs:
+            dot.edge(inp[0].name, node.name)
+    return dot

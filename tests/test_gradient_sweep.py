@@ -15,6 +15,22 @@ import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import ndarray as nd
 from incubator_mxnet_tpu.test_utils import check_numeric_gradient
 
+
+def _tols():
+    """TPU tolerance ladder (TPU_TESTS.md discipline). The noisy side on
+    TPU is the FINITE DIFFERENCE, not the op: transcendental-approximation
+    error on each scalar eval (~2e-4 over a summed (3,4) input) divides by
+    2*eps, bounding FD noise at ~2e-2 absolute for eps=1e-2 — verified for
+    log_softmax by checking the analytic grad against the exact f64
+    formula (1.7e-6 agreement). Wrong-vjp bugs are O(1) off, so the
+    widened bound keeps the sweep's power."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return dict(eps=1e-2, rtol=3e-2, atol=2e-2)
+    return dict(eps=1e-3, rtol=1e-2, atol=1e-3)
+
+
 rs = np.random.RandomState(42)
 
 # inputs in safe smooth domains
@@ -77,23 +93,26 @@ BINARY = [
 
 @pytest.mark.parametrize("name,op,arr", UNARY, ids=[c[0] for c in UNARY])
 def test_unary_gradient(name, op, arr):
-    check_numeric_gradient(lambda x: op(x).sum(), [nd.array(arr)])
+    check_numeric_gradient(lambda x: op(x).sum(), [nd.array(arr)],
+                           **_tols())
 
 
 @pytest.mark.parametrize("name,op,a,b", BINARY, ids=[c[0] for c in BINARY])
 def test_binary_gradient(name, op, a, b):
     check_numeric_gradient(lambda x, y: op(x, y).sum(),
-                           [nd.array(a), nd.array(b)])
+                           [nd.array(a), nd.array(b)], **_tols())
 
 
 def test_loss_gradients():
     from incubator_mxnet_tpu import gluon
 
     y = nd.array(S)
-    t = nd.array(X)
+    # label offset keeps pred-label in [-3.7, -1.5]: >=0.5 away from the
+    # L1 kink (0) and the Huber transition (-1), so FD never crosses them
+    t = nd.array(X + 2.0)
     for loss in (gluon.loss.L2Loss(), gluon.loss.L1Loss(),
                  gluon.loss.HuberLoss(), gluon.loss.LogisticLoss()):
-        check_numeric_gradient(lambda p: loss(p, t).sum(), [y])
+        check_numeric_gradient(lambda p: loss(p, t).sum(), [y], **_tols())
 
 
 def test_norm_layer_gradients():

@@ -1,0 +1,145 @@
+"""Legacy top-level modules (reference python/mxnet/{callback,monitor,
+visualization,name,attribute,util,engine,registry}.py)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_speedometer_and_log_metric(caplog):
+    metric = mx.metric.Accuracy()
+    metric.update(mx.nd.array(np.array([0, 1])),
+                  mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8]])))
+    sp = mx.callback.Speedometer(batch_size=32, frequent=2,
+                                 auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 5):
+            sp(mx.callback.BatchEndParam(epoch=0, nbatch=nbatch,
+                                         eval_metric=metric, locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+    cb = mx.callback.log_train_metric(1)
+    with caplog.at_level(logging.INFO):
+        cb(mx.callback.BatchEndParam(epoch=0, nbatch=1,
+                                     eval_metric=metric, locals=None))
+    assert any("Train-accuracy" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_saves(tmp_path):
+    import incubator_mxnet_tpu.symbol as sym
+
+    x = sym.var("data")
+    net = sym.FullyConnected(x, num_hidden=4, name="fc")
+    prefix = str(tmp_path / "ck")
+    cb = mx.callback.do_checkpoint(prefix, period=1)
+    args = {"fc_weight": mx.nd.ones((4, 3)), "fc_bias": mx.nd.zeros((4,))}
+    cb(0, net, args, {})
+    assert (tmp_path / "ck-symbol.json").exists()
+    assert (tmp_path / "ck-0001.params").exists()
+
+
+def test_monitor_collects_stats():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.tic()
+    net(mx.nd.uniform(shape=(2, 4)))
+    rows = mon.toc()
+    assert len(rows) >= 2
+    names = [r[1] for r in rows]
+    assert any("dense" in n for n in names), names
+    assert all(np.isfinite(float(r[2])) for r in rows)
+
+
+def test_print_summary(capsys):
+    import incubator_mxnet_tpu.symbol as sym
+
+    x = sym.var("data")
+    h = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="act1")
+    out = sym.FullyConnected(h, num_hidden=2, name="fc2")
+    mx.visualization.print_summary(out, shape={"data": (1, 4)})
+    text = capsys.readouterr().out
+    assert "fc1" in text and "fc2" in text
+    # fc1: 4*8+8 = 40; fc2: 8*2+2 = 18
+    assert "Total params: 58" in text
+
+
+def test_plot_network_gated():
+    import incubator_mxnet_tpu.symbol as sym
+
+    x = sym.var("data")
+    out = sym.FullyConnected(x, num_hidden=2, name="fc")
+    try:
+        import graphviz  # noqa: F401
+
+        have = True
+    except ImportError:
+        have = False
+    if have:
+        assert mx.viz.plot_network(out) is not None
+    else:
+        with pytest.raises(ImportError, match="print_summary"):
+            mx.viz.plot_network(out)
+
+
+def test_name_prefix_scope():
+    import incubator_mxnet_tpu.symbol as sym
+
+    with mx.name.NameManager():
+        a = sym.FullyConnected(sym.var("x"), num_hidden=2)
+        b = sym.FullyConnected(sym.var("y"), num_hidden=2)
+    assert a.name != b.name
+    pm = mx.name.Prefix("block1_")
+    assert pm.get(None, "conv").startswith("block1_conv")
+    assert pm.get("explicit", "conv") == "block1_explicit"
+
+
+def test_attr_scope():
+    with mx.attribute.AttrScope(ctx_group="dev1", lr_mult="2"):
+        attrs = mx.attribute.current_attrs()
+        assert attrs == {"ctx_group": "dev1", "lr_mult": "2"}
+        with mx.attribute.AttrScope(lr_mult="3"):
+            assert mx.attribute.current_attrs()["lr_mult"] == "3"
+    assert mx.attribute.current_attrs() == {}
+    with pytest.raises(ValueError):
+        mx.attribute.AttrScope(lr_mult=2)
+
+
+def test_util_and_engine():
+    assert mx.util.use_np(int) is int
+    mx.util.set_np()
+    assert mx.util.is_np_array()
+    mx.util.reset_np()
+    assert mx.util.getenv("MXTPU_ENGINE_TYPE") == "async"
+
+    prev = mx.engine.set_bulk_size(10)
+    assert mx.engine.set_bulk_size(prev) == 10
+    with mx.engine.bulk(5):
+        pass
+
+
+def test_registry_factory():
+    class Base:
+        pass
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+
+    @alias("t1", "tee")
+    class Thing(Base):
+        pass
+
+    reg(Thing)
+    assert isinstance(create("thing"), Thing)
+    assert isinstance(create("tee"), Thing)
+    with pytest.raises(ValueError, match="unknown thing"):
+        create("nope")
