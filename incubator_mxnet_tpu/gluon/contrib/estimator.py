@@ -1,0 +1,328 @@
+"""Gluon Estimator — the high-level fit API (reference
+``python/mxnet/gluon/contrib/estimator/``: ``Estimator`` + event-handler
+framework). Drives the eager Gluon train loop (autograd.record →
+backward → trainer.step) with composable handlers; the same five hook
+points as the reference (train begin/end, epoch begin/end, batch
+begin/end).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List, Optional, Sequence
+
+import copy
+
+from ... import autograd
+from ...metric import Accuracy, EvalMetric, Loss as LossMetric
+from ..trainer import Trainer as GluonTrainer
+
+
+# --------------------------------------------------------------------------
+# Event handler framework (reference estimator/event_handler.py)
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference StoppingHandler)."""
+
+    def __init__(self, max_epoch: Optional[int] = None,
+                 max_batch: Optional[int] = None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update train metrics each batch; reset at epoch begin (reference
+    MetricHandler)."""
+
+    def __init__(self, metrics: Sequence[EvalMetric]):
+        self.metrics = list(metrics)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if isinstance(m, LossMetric):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run evaluation every ``epoch_period`` epochs (reference
+    ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period: int = 1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log speed + metrics (reference LoggingHandler)."""
+
+    def __init__(self, log_interval: Any = "epoch",
+                 metrics: Optional[Sequence[EvalMetric]] = None):
+        self.log_interval = log_interval
+        self.metrics = list(metrics or [])
+        self.batch_index = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        logging.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("Training finished in %.3fs",
+                     time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+        self.processed_samples = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = " ".join(f"{n}={v:.6f}" for m in self.metrics
+                       for n, v in [m.get()])
+        logging.info("Epoch finished in %.3fs: %s",
+                     time.time() - self.epoch_start, msg)
+
+    def batch_end(self, estimator, *args, batch=None, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = " ".join(f"{n}={v:.6f}" for m in self.metrics
+                           for n, v in [m.get()])
+            logging.info("Batch[%d] %s", self.batch_index, msg)
+
+
+class CheckpointHandler(EpochEnd):
+    """Save parameters every ``epoch_period`` epochs (reference
+    CheckpointHandler core behavior)."""
+
+    def __init__(self, model_dir: str, model_prefix: str = "model",
+                 epoch_period: int = 1):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period == 0:
+            import os
+
+            os.makedirs(self.model_dir, exist_ok=True)
+            path = os.path.join(
+                self.model_dir,
+                f"{self.model_prefix}-epoch{self.current_epoch}.params")
+            estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when a monitored metric stops improving (reference
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor: EvalMetric, mode: str = "auto",
+                 patience: int = 0, min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        name = monitor.get()[0]
+        if mode == "auto":
+            mode = "min" if ("loss" in name or "error" in name) else "max"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, value = self.monitor.get()
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+        return self.stop_training
+
+
+# --------------------------------------------------------------------------
+
+class Estimator:
+    """High-level train/evaluate facade (reference
+    ``gluon.contrib.estimator.Estimator``).
+
+    Usage::
+
+        est = Estimator(net, loss, train_metrics=Accuracy(),
+                        trainer=trainer, context=mx.tpu())
+        est.fit(train_data, val_data, epochs=3)
+    """
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        from ...device import current_context
+
+        self.net = net
+        self.loss = loss
+        self.context = context if context is not None else current_context()
+        self.train_metrics = self._as_list(train_metrics) or [Accuracy()]
+        # deepcopy preserves metric configuration (top_k, names, ...)
+        self.val_metrics = self._as_list(val_metrics) or [
+            copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.reset()
+        self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+        self.trainer = trainer if trainer is not None else GluonTrainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    @staticmethod
+    def _as_list(x):
+        if x is None:
+            return []
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+
+    # -- evaluation ---------------------------------------------------------
+    def _to_ctx(self, arr):
+        if self.context is not None and hasattr(arr, "as_in_context"):
+            return arr.as_in_context(self.context)
+        return arr
+
+    def evaluate(self, val_data) -> None:
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data = self._to_ctx(batch[0])
+            label = self._to_ctx(batch[1])
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            self.val_loss_metric.update(0, loss)
+            for m in self.val_metrics:
+                m.update(label, pred)
+
+    # -- training -----------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs: Optional[int] = None,
+            event_handlers: Optional[List[Any]] = None,
+            batches: Optional[int] = None) -> None:
+        handlers = list(event_handlers or [])
+        has_stopper = any(
+            hasattr(h, "stop_training") for h in handlers)
+        if epochs is None and batches is None and not has_stopper:
+            raise ValueError(
+                "fit() needs a stopping condition: pass epochs=, batches=, "
+                "or an event handler with stop_training (reference "
+                "Estimator requires epochs or batches)")
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.train_loss_metric] + self.train_metrics))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+
+        def fire(event: str, **kw) -> bool:
+            stop = False
+            for h in handlers:
+                fn = getattr(h, event, None)
+                if fn is not None:
+                    if fn(self, **kw):
+                        stop = True
+            return stop
+
+        stoppers = [h for h in handlers if hasattr(h, "stop_training")]
+
+        def should_stop() -> bool:
+            return any(h.stop_training for h in stoppers)
+
+        fire("train_begin")
+        while not should_stop():
+            fire("epoch_begin")
+            for batch in train_data:
+                fire("batch_begin", batch=batch)
+                data = self._to_ctx(batch[0])
+                label = self._to_ctx(batch[1])
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                fire("batch_end", batch=batch, pred=pred, label=label,
+                     loss=loss)
+                if should_stop():
+                    break
+            fire("epoch_end")
+        fire("train_end")

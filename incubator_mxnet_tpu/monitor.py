@@ -57,8 +57,22 @@ class Monitor:
                     (self.step, name + suffix,
                      np.asarray(self.stat_func(arr))))
 
+        if any(b is block for b, _ in self._handles):
+            raise RuntimeError(
+                "Monitor already installed on this block; call uninstall() "
+                "first")
         for child in self._walk(block):
-            self._handles.append(child.register_forward_hook(hook))
+            child.register_forward_hook(hook)
+            self._handles.append((child, hook))
+
+    def uninstall(self) -> None:
+        """Remove every hook this monitor installed."""
+        for blk, hook in self._handles:
+            try:
+                blk._forward_hooks.remove(hook)
+            except ValueError:
+                pass
+        self._handles = []
 
     def _walk(self, block):
         yield block

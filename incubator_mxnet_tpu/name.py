@@ -6,7 +6,22 @@ managers."""
 
 from __future__ import annotations
 
+import threading
+
 from .symbol.symbol import _name_manager as _global_manager
+
+
+class _Stack(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_stack = _Stack()
+
+
+def current():
+    """The innermost active NameManager scope (None if no scope)."""
+    return _stack.stack[-1] if _stack.stack else None
 
 
 class NameManager:
@@ -25,9 +40,11 @@ class NameManager:
     def __enter__(self):
         self._saved = dict(_global_manager._counters)
         _global_manager._counters.clear()
+        _stack.stack.append(self)
         return self
 
     def __exit__(self, *exc):
+        _stack.stack.pop()
         _global_manager._counters.clear()
         _global_manager._counters.update(self._saved)
 

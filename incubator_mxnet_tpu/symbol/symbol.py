@@ -578,12 +578,26 @@ def _apply_op(op_name: str, sym_args: Sequence[Symbol],
     if opdef is None:
         raise AttributeError(f"unknown op {op_name!r}")
     canonical = opdef.name
-    node_name = name or _name_manager.get(canonical.lower())
+    # an active mx.name.NameManager/Prefix scope takes precedence over the
+    # module-global manager; an active mx.attribute.AttrScope contributes
+    # node attrs (reference _apply_op consults both current stacks)
+    from .. import name as _name_mod
+
+    mgr = _name_mod.current()
+    if mgr is not None:
+        node_name = mgr.get(name, canonical.lower())
+    else:
+        node_name = name or _name_manager.get(canonical.lower())
 
     req, opt, variadic = _op_input_params(opdef)
     # split kwargs into symbol inputs vs attrs
     sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
     attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+    from ..attribute import current_attrs as _scope_attrs
+
+    scoped = _scope_attrs()
+    if scoped:
+        attrs = {**scoped, **attrs}
     inputs: List[Tuple[_Node, int]] = []
     if variadic:
         for s in sym_args:

@@ -47,25 +47,26 @@ def reset_np() -> None:
 
 def getenv(name: str):
     """Runtime config read (reference ``mx.util.getenv`` over the C API's
-    MXGetEnv): consults the MXTPU knob registry first, then the process
-    environment."""
+    MXGetEnv): registered MXTPU knobs come from the knob registry (typed,
+    override-aware); anything else reads the live process environment."""
     import os
 
     from .config import config
 
-    try:
+    if name in config._knobs:
         return config.get(name)
-    except KeyError:
-        return os.environ.get(name)
+    return os.environ.get(name)
 
 
 def setenv(name: str, value) -> None:
-    """Runtime config write (reference ``mx.util.setenv``)."""
+    """Runtime config write (reference ``mx.util.setenv``): registered
+    knobs get a runtime override; anything else writes the real process
+    environment (visible to libraries and child processes)."""
+    import os
+
     from .config import config
 
-    try:
+    if name in config._knobs:
         config.set(name, value)
-    except KeyError:
-        import os
-
+    else:
         os.environ[name] = str(value)

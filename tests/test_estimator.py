@@ -1,0 +1,96 @@
+"""Gluon Estimator API (reference gluon/contrib/estimator)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+from incubator_mxnet_tpu.metric import Accuracy, Loss
+
+
+_W = np.random.RandomState(99).randn(8, 3).astype(np.float32)
+
+
+def _data(n=64, d=8, c=3, batch=16, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, d).astype(np.float32)
+    y = (x @ _W).argmax(axis=1).astype(np.float32)  # learnable labels
+    return [(mx.nd.array(x[i:i + batch]), mx.nd.array(y[i:i + batch]))
+            for i in range(0, n, batch)]
+
+
+def _net(d=8, c=3):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=d, activation="relu"),
+            nn.Dense(c, in_units=16))
+    net.initialize(init="xavier")
+    return net
+
+
+def test_estimator_fit_and_evaluate(caplog):
+    mx.random.seed(0)
+    net = _net()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    est = est_mod.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            train_metrics=Accuracy(), trainer=tr)
+    data = _data()
+    with caplog.at_level(logging.INFO):
+        est.fit(data, val_data=_data(seed=1), epochs=8)
+    assert any("Training finished" in r.message for r in caplog.records)
+    # trained to better-than-chance on 3 classes
+    name, acc = est.train_metrics[0].get()
+    assert acc > 0.6, (name, acc)
+    # validation ran and populated val metrics
+    assert est.val_loss_metric.get()[1] > 0
+
+
+def test_estimator_max_batch_stops():
+    net = _net()
+    est = est_mod.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    seen = []
+
+    class Counter(est_mod.BatchEnd):
+        def batch_end(self, estimator, **kw):
+            seen.append(1)
+
+    est.fit(_data(), batches=3, event_handlers=[Counter()])
+    assert len(seen) == 3
+
+
+def test_estimator_checkpoint_handler(tmp_path):
+    net = _net()
+    est = est_mod.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(_data(), epochs=2, event_handlers=[
+        est_mod.CheckpointHandler(str(tmp_path), "m", epoch_period=1)])
+    assert (tmp_path / "m-epoch1.params").exists()
+    assert (tmp_path / "m-epoch2.params").exists()
+
+
+def test_early_stopping_handler():
+    net = _net()
+    loss_metric = Loss(name="train_loss")
+    est = est_mod.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+
+    class Worsen(est_mod.EpochEnd):
+        """Force the monitored metric to 'worsen' monotonically."""
+        def __init__(self, m):
+            self.m = m
+            self.v = 0.0
+
+        def epoch_end(self, estimator, **kw):
+            self.m.reset()
+            self.v += 1.0
+            self.m.update(0, mx.nd.array(np.array([self.v])))
+
+    early = est_mod.EarlyStoppingHandler(loss_metric, patience=1)
+    est.fit(_data(), epochs=50,
+            event_handlers=[Worsen(loss_metric), early])
+    # stopped long before 50 epochs: best at epoch1, patience 1 -> stop ~3
+    assert early.stop_training
+    stop_h = [h for h in [early]][0]
+    assert stop_h.best == 1.0

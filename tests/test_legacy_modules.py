@@ -143,3 +143,50 @@ def test_registry_factory():
     assert isinstance(create("tee"), Thing)
     with pytest.raises(ValueError, match="unknown thing"):
         create("nope")
+
+
+def test_name_prefix_affects_symbol_names():
+    import incubator_mxnet_tpu.symbol as sym
+
+    with mx.name.Prefix("blk1_"):
+        s = sym.FullyConnected(sym.var("x"), num_hidden=2)
+    assert s.name.startswith("blk1_fullyconnected"), s.name
+    s2 = sym.FullyConnected(sym.var("x"), num_hidden=2)
+    assert not s2.name.startswith("blk1_")
+
+
+def test_attr_scope_attaches_to_symbols():
+    import incubator_mxnet_tpu.symbol as sym
+
+    with mx.attribute.AttrScope(ctx_group="dev1", lr_mult="2"):
+        s = sym.FullyConnected(sym.var("x"), num_hidden=2)
+    assert s.attr("ctx_group") == "dev1"
+    assert s.attr("lr_mult") == "2"
+    s2 = sym.FullyConnected(sym.var("x"), num_hidden=2)
+    assert s2.attr("ctx_group") is None
+
+
+def test_monitor_uninstall():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.initialize(init="xavier")
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(net)
+    with pytest.raises(RuntimeError, match="uninstall"):
+        mon.install(net)
+    mon.uninstall()
+    mon.tic()
+    net(mx.nd.uniform(shape=(2, 4)))
+    assert mon.toc() == []
+    mon.install(net)  # re-install after uninstall is fine
+
+
+def test_estimator_requires_stopping_condition():
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2, in_units=4))
+    net.initialize(init="xavier")
+    est = est_mod.Estimator(net, gluon.loss.L2Loss())
+    with pytest.raises(ValueError, match="stopping condition"):
+        est.fit([(mx.nd.zeros((2, 4)), mx.nd.zeros((2, 2)))])
