@@ -107,6 +107,59 @@ _NN = {
                                             "ratios": (1.0, 2.0)}),
 }
 
+# round-3 waves: numpy-parity, fft, np.linalg, moe
+_UNARY_1D.update(dict.fromkeys("""
+exp2 sinc i0 fabs signbit std var median ptp cumprod nanmax nanmin
+nanmean nanstd nanvar nancumsum nancumprod count_nonzero flipud fliplr
+ediff1d atleast_2d atleast_3d real imag conj angle fftshift ifftshift
+""".split(), ([("B", 1024)], {})))
+_UNARY_1D.update({
+    "roll": ([("B", 1024)], {"shift": 7}),
+    "rot90": ([(64, 64)], {}),
+    "tril": ([(128, 128)], {}),
+    "triu": ([(128, 128)], {}),
+    "trace_op": ([(128, 128)], {}),
+    "moveaxis": ([(8, 16, 32)], {"source": 0, "destination": 2}),
+    "diff": ([("B", 1024)], {}),
+    "vander": ([(256,)], {"n": 8}),
+    "quantile": ([("B", 1024)], {"q": 0.5}),
+    "percentile": ([("B", 1024)], {"q": 30.0}),
+    "fft": ([("B", 1024)], {}),
+    "ifft": ([("B", 1024)], {}),
+    "rfft": ([("B", 1024)], {}),
+    "fft2": ([(64, 64)], {}),
+    "fftn": ([(16, 32, 32)], {}),
+})
+_BINARY.update(dict.fromkeys("""
+logaddexp logaddexp2 copysign heaviside fmod nextafter float_power
+floor_divide kron outer inner vdot cross searchsorted digitize isin
+""".split(), ([("B", 1024), ("B", 1024)], {})))
+_BINARY.update({
+    "kron": ([(32, 32), (8, 8)], {}),
+    "outer": ([(512,), (512,)], {}),
+    "inner": ([(128, 128), (128, 128)], {}),
+    "vdot": ([(128, 128), (128, 128)], {}),
+    "cross": ([("B", 3), ("B", 3)], {}),
+    "tensordot": ([(128, 128), (128, 128)], {"axes": 1}),
+    "convolve": ([(1024,), (64,)], {}),
+    "correlate": ([(1024,), (64,)], {}),
+    "polyval": ([(8,), ("B", 64)], {}),
+    "searchsorted": ([(1024,), (256,)], {}),
+    "digitize": ([("B", 64), (32,)], {}),
+})
+_MATMUL.update({
+    "linalg_norm": ([(256, 256)], {}),
+    "linalg_solve": ("spd_b", {}),
+    "linalg_qr": ([(256, 256)], {}),
+    "linalg_svd": ([(128, 128)], {}),
+    "linalg_eigh": ("spd", {}),
+    "linalg_eigvalsh": ("spd", {}),
+    "linalg_cholesky": ("spd", {}),
+    "linalg_pinv": ([(128, 128)], {}),
+    "linalg_matrix_power": ([(128, 128)], {"n": 3}),
+    "moe_ffn": ("moe", {}),
+})
+
 ARGSPECS = {**_UNARY_1D, **_REDUCE, **_BINARY, **_SCALAR, **_MATMUL, **_NN}
 
 _SHAPE1 = dict.fromkeys("""
@@ -352,6 +405,19 @@ def _make_inputs(nd, spec, batch):
     if spec == "batch_take":
         return [nd.array(rng.rand(batch, 64).astype(np.float32)),
                 nd.array(rng.randint(0, 64, (batch,)).astype(np.float32))]
+    if spec == "spd_b":
+        a = rng.rand(8, 64, 64).astype(np.float32)
+        return [nd.array(a @ a.transpose(0, 2, 1)
+                         + 8 * np.eye(64, dtype=np.float32)),
+                nd.array(rng.rand(8, 64, 64).astype(np.float32))]
+    if spec == "moe":
+        E, D, H = 8, 64, 128
+        return [nd.array(rng.rand(batch, D).astype(np.float32)),
+                nd.array(rng.rand(D, E).astype(np.float32)),
+                nd.array(rng.rand(E, D, H).astype(np.float32) * 0.1),
+                nd.array(np.zeros((E, H), np.float32)),
+                nd.array(rng.rand(E, H, D).astype(np.float32) * 0.1),
+                nd.array(np.zeros((E, D), np.float32))]
     if spec == "boxes2":
         b = rng.rand(64, 4).astype(np.float32)
         b[:, 2:] = b[:, :2] + 0.2

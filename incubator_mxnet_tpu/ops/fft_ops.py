@@ -9,52 +9,78 @@ complex64 arrays wrapped in NDArray — numpy semantics, matching mx.np.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from .registry import register
 
 
+@functools.lru_cache(maxsize=1)
+def _axon_backend() -> bool:
+    """The experimental axon TPU tunnel cannot lower FFT (complex
+    support); standard cpu/tpu/gpu backends can."""
+    try:
+        import jax.extend.backend as jxb
+
+        return "axon" in getattr(jxb.get_backend(), "platform_version", "")
+    except Exception:
+        return False
+
+
+def _fft_dispatch(fn, x, **kw):
+    """Run an FFT on the host CPU backend when the accelerator can't lower
+    it (eager arrays only — the reference's FFT is likewise a
+    device-specific contrib op). Under jit on such a backend the XLA
+    error surfaces to the caller."""
+    if _axon_backend() and not isinstance(x, jax.core.Tracer):
+        cpu = jax.devices("cpu")[0]
+        return fn(jax.device_put(x, cpu), **kw)
+    return fn(x, **kw)
+
+
 # --- fft ---------------------------------------------------------------------
 
 @register("fft")
 def fft(x, n=None, axis=-1, norm=None):
-    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+    return _fft_dispatch(jnp.fft.fft, x, n=n, axis=axis, norm=norm)
 
 
 @register("ifft")
 def ifft(x, n=None, axis=-1, norm=None):
-    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+    return _fft_dispatch(jnp.fft.ifft, x, n=n, axis=axis, norm=norm)
 
 
 @register("rfft")
 def rfft(x, n=None, axis=-1, norm=None):
-    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+    return _fft_dispatch(jnp.fft.rfft, x, n=n, axis=axis, norm=norm)
 
 
 @register("irfft")
 def irfft(x, n=None, axis=-1, norm=None):
-    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+    return _fft_dispatch(jnp.fft.irfft, x, n=n, axis=axis, norm=norm)
 
 
 @register("fft2")
 def fft2(x, s=None, axes=(-2, -1), norm=None):
-    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=norm)
+    return _fft_dispatch(jnp.fft.fft2, x, s=s, axes=tuple(axes), norm=norm)
 
 
 @register("ifft2")
 def ifft2(x, s=None, axes=(-2, -1), norm=None):
-    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=norm)
+    return _fft_dispatch(jnp.fft.ifft2, x, s=s, axes=tuple(axes),
+                         norm=norm)
 
 
 @register("fftn")
 def fftn(x, s=None, axes=None, norm=None):
-    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+    return _fft_dispatch(jnp.fft.fftn, x, s=s, axes=axes, norm=norm)
 
 
 @register("ifftn")
 def ifftn(x, s=None, axes=None, norm=None):
-    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+    return _fft_dispatch(jnp.fft.ifftn, x, s=s, axes=axes, norm=norm)
 
 
 @register("fftshift")
