@@ -32,11 +32,25 @@ def _axon_backend() -> bool:
 def _fft_dispatch(fn, x, **kw):
     """Run an FFT on the host CPU backend when the accelerator can't lower
     it (eager arrays only — the reference's FFT is likewise a
-    device-specific contrib op). Under jit on such a backend the XLA
-    error surfaces to the caller."""
+    device-specific contrib op). The result is transferred back to the
+    input's device so downstream ops stay on the accelerator. Under jit
+    on such a backend the XLA error surfaces to the caller."""
     if _axon_backend() and not isinstance(x, jax.core.Tracer):
         cpu = jax.devices("cpu")[0]
-        return fn(jax.device_put(x, cpu), **kw)
+        src = None
+        try:
+            src = next(iter(x.devices()))
+        except Exception:
+            pass
+        out = fn(jax.device_put(x, cpu), **kw)
+        # the axon backend cannot hold complex arrays (the root cause of
+        # its missing FFT); complex results stay host-resident — take
+        # real/imag and .as_in_context() to return to the accelerator.
+        # Real-valued results (irfft) transfer back transparently.
+        if (src is not None and src.platform != "cpu"
+                and not jnp.iscomplexobj(out)):
+            out = jax.device_put(out, src)
+        return out
     return fn(x, **kw)
 
 
@@ -132,24 +146,29 @@ def linalg_solve(a, b):
 
 @register("linalg_lstsq", differentiable=False)
 def linalg_lstsq(a, b, rcond=None):
-    return jnp.linalg.lstsq(a, b, rcond=rcond)
+    return tuple(jnp.linalg.lstsq(a, b, rcond=rcond))
 
 
 @register("linalg_qr")
 def linalg_qr(a, mode="reduced"):
-    # mode='r' returns a single array; 'reduced'/'complete' return (q, r)
-    return jnp.linalg.qr(a, mode=mode)
+    # mode='r' returns a single array; 'reduced'/'complete' return (q, r).
+    # jnp returns a QRResult NamedTuple — convert to a plain tuple so the
+    # tape's vjp cotangent structure matches (invoke reconstructs plain
+    # tuples on backward).
+    out = jnp.linalg.qr(a, mode=mode)
+    return tuple(out) if isinstance(out, tuple) else out
 
 
 @register("linalg_svd")
 def linalg_svd(a, full_matrices=True, compute_uv=True):
-    return jnp.linalg.svd(a, full_matrices=full_matrices,
-                          compute_uv=compute_uv)
+    out = jnp.linalg.svd(a, full_matrices=full_matrices,
+                         compute_uv=compute_uv)
+    return tuple(out) if isinstance(out, tuple) else out
 
 
 @register("linalg_eigh")
 def linalg_eigh(a, UPLO="L"):
-    return jnp.linalg.eigh(a, UPLO=UPLO)
+    return tuple(jnp.linalg.eigh(a, UPLO=UPLO))
 
 
 @register("linalg_eigvalsh")

@@ -251,9 +251,9 @@ def test_np_fft_roundtrip():
 
 
 def _on_axon():
-    import jax.extend.backend as jxb
+    from incubator_mxnet_tpu.ops.fft_ops import _axon_backend
 
-    return "axon" in getattr(jxb.get_backend(), "platform_version", "")
+    return _axon_backend()
 
 
 @pytest.mark.skipif(_on_axon(), reason="axon tunnel cannot lower FFT; "
@@ -270,3 +270,26 @@ def test_fft_gradient():
     want = W.real.sum(axis=0)
     np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_multi_output_linalg_backward():
+    """NamedTuple-returning jnp.linalg ops must present plain tuples to
+    the tape (regression: QRResult broke vjp cotangent structure)."""
+    a = mx.nd.array(rs.rand(6, 6).astype(np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        q, r = nd.invoke_op("linalg_qr", a)
+        loss = (q * q).sum() + nd.triu(r).sum()
+    loss.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+
+    spd = rs.rand(6, 6).astype(np.float32)
+    spd = spd @ spd.T + 6 * np.eye(6, dtype=np.float32)
+    b = mx.nd.array(spd)
+    b.attach_grad()
+    with mx.autograd.record():
+        w, v = nd.invoke_op("linalg_eigh", b)
+        l2 = w.sum()
+    l2.backward()
+    # d(sum of eigenvalues)/dA = I for symmetric A
+    np.testing.assert_allclose(b.grad.asnumpy(), np.eye(6), atol=2e-4)
