@@ -8,12 +8,12 @@ from .control_flow import cond, foreach, while_loop
 nd = control_flow
 
 __all__ = ["foreach", "while_loop", "cond", "nd", "control_flow",
-           "quantization"]
+           "quantization", "text"]
 
 
 def __getattr__(name):
-    if name == "quantization":
+    if name in ("quantization", "text"):
         import importlib
 
-        return importlib.import_module(".quantization", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

@@ -1,0 +1,225 @@
+"""``mx.contrib.text`` — vocabulary + token embeddings (reference
+``python/mxnet/contrib/text/{vocab,embedding,utils}.py``).
+
+The reference downloads pretrained GloVe/fastText tables; this
+environment has no network egress, so ``embedding.create`` by remote name
+raises with guidance and ``CustomEmbedding`` loads any local
+word-per-line vector file (the reference's escape hatch, same format).
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def count_tokens_from_str(source_str: str, token_delim: str = " ",
+                          seq_delim: str = "\n", to_lower: bool = False,
+                          counter_to_update: Optional[
+                              collections.Counter] = None
+                          ) -> collections.Counter:
+    """Tokenize a string and count tokens (reference
+    ``text.utils.count_tokens_from_str``)."""
+    source_str = re.sub(re.escape(seq_delim), token_delim, source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in source_str.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (reference ``text.vocab.Vocabulary``):
+    index 0 is the unknown token; ``reserved_tokens`` follow; then tokens
+    by descending frequency (ties broken alphabetically)."""
+
+    def __init__(self, counter: Optional[collections.Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise ValueError("unknown_token must not be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token: List[str] = [unknown_token] + reserved_tokens
+
+        if counter is not None:
+            pairs = sorted(counter.items())
+            pairs.sort(key=lambda p: p[1], reverse=True)
+            taken = set(self._idx_to_token)
+            budget = most_freq_count if most_freq_count is not None \
+                else len(pairs)
+            for tok, freq in pairs:
+                if freq < min_freq or budget <= 0:
+                    break
+                if tok in taken:
+                    continue
+                self._idx_to_token.append(tok)
+                budget -= 1
+        self._token_to_idx: Dict[str, int] = {
+            t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens: Union[str, Sequence[str]]):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices: Union[int, Sequence[int]]):
+        single = not isinstance(indices, (list, tuple, np.ndarray))
+        idxs = [indices] if single else list(indices)
+        toks = []
+        for i in idxs:
+            i = int(i)
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"index {i} out of vocabulary range")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base: vocabulary + a (V, D) vector table surfaced as NDArray
+    (reference ``text.embedding._TokenEmbedding``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self) -> int:
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup: bool = False):
+        from .. import ndarray as nd
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = np.array([self._token_to_idx.get(t, 0) for t in toks])
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        out = nd.array(vecs[0] if single else vecs)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors) -> None:
+        from .. import ndarray as nd
+
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        vecs = vecs.reshape(len(toks), -1)
+        table = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} not in the embedding")
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(table)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Load a local word-per-line vector file: ``token v0 v1 ... vD``
+    (reference ``text.embedding.CustomEmbedding``)."""
+
+    def __init__(self, pretrained_file_path: str, elem_delim: str = " ",
+                 encoding: str = "utf8",
+                 vocabulary: Optional[Vocabulary] = None, **kwargs):
+        from .. import ndarray as nd
+
+        tokens: List[str] = []
+        vecs: List[np.ndarray] = []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tokens.append(parts[0])
+                vecs.append(np.asarray([float(x) for x in parts[1:]],
+                                       np.float32))
+        if not vecs:
+            raise ValueError(f"no vectors in {pretrained_file_path}")
+        dim = len(vecs[0])
+        counter = collections.Counter({t: 1 for t in tokens})
+        if vocabulary is not None:
+            super().__init__(counter=None, **kwargs)
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self._unknown_token = vocabulary.unknown_token
+            self._reserved_tokens = vocabulary.reserved_tokens
+        else:
+            super().__init__(counter=counter, **kwargs)
+        table = np.zeros((len(self), dim), np.float32)
+        by_tok = dict(zip(tokens, vecs))
+        for i, t in enumerate(self._idx_to_token):
+            if t in by_tok:
+                table[i] = by_tok[t]
+        self._vec_len = dim
+        self._idx_to_vec = nd.array(table)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    ``text.embedding.CompositeEmbedding``)."""
+
+    def __init__(self, vocabulary: Vocabulary,
+                 token_embeddings: Sequence[_TokenEmbedding]):
+        from .. import ndarray as nd
+
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for emb in token_embeddings]
+        table = np.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        self._idx_to_vec = nd.array(table)
+
+
+def create(embedding_name: str, **kwargs):
+    """Reference ``text.embedding.create('glove', ...)`` — remote
+    pretrained tables require network egress, unavailable here; load a
+    local file with CustomEmbedding instead."""
+    raise RuntimeError(
+        f"pretrained embedding {embedding_name!r} requires downloading "
+        "(no network egress in this environment); use "
+        "contrib.text.CustomEmbedding(path) with a local vector file")
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference API surface; nothing is downloadable here."""
+    return {} if embedding_name is None else []

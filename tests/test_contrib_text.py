@@ -1,0 +1,86 @@
+"""mx.contrib.text — vocabulary + token embeddings (reference
+contrib/text/{vocab,embedding,utils}.py)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.count_tokens_from_str("a b b\nc C", to_lower=True)
+    assert c == collections.Counter({"b": 2, "c": 2, "a": 1})
+
+
+def test_vocabulary_ordering_and_lookup():
+    counter = collections.Counter(
+        {"the": 10, "cat": 5, "sat": 5, "rare": 1})
+    v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    assert v.idx_to_token[2] == "the"
+    # freq ties broken alphabetically: cat before sat
+    assert v.idx_to_token[3:5] == ["cat", "sat"]
+    assert "rare" not in v.token_to_idx
+    assert v.to_indices("the") == 2
+    assert v.to_indices(["the", "nope"]) == [2, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "the"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_custom_embedding_roundtrip(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["world", "missing"]).asnumpy(),
+        [[4, 5, 6], [0, 0, 0]])
+    # HELLO falls back to lowercase
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["HELLO"], lower_case_backup=True
+                               ).asnumpy(), [[1, 2, 3]])
+    emb.update_token_vectors("hello", mx.nd.array(
+        np.array([9.0, 9.0, 9.0], np.float32)))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("x 1.0 2.0\ny 3.0 4.0\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("x 5.0\ny 6.0\n")
+    vocab = text.Vocabulary(collections.Counter({"x": 2, "y": 1}))
+    comp = text.CompositeEmbedding(
+        vocab, [text.CustomEmbedding(str(p1)),
+                text.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("x").asnumpy(), [1, 2, 5])
+
+
+def test_create_raises_without_network():
+    with pytest.raises(RuntimeError, match="CustomEmbedding"):
+        text.create("glove")
+    assert text.get_pretrained_file_names() == {}
+
+
+def test_embedding_feeds_gluon_embedding_layer():
+    """The reference workflow: vocab+vectors initialize nn.Embedding."""
+    from incubator_mxnet_tpu.gluon import nn
+
+    counter = collections.Counter({"a": 2, "b": 1})
+    v = text.Vocabulary(counter)
+    layer = nn.Embedding(len(v), 4)
+    layer.initialize()
+    idx = mx.nd.array(np.array(v.to_indices(["a", "b", "zzz"]),
+                               np.float32))
+    out = layer(idx)
+    assert out.shape == (3, 4)
