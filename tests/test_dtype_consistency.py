@@ -1,0 +1,100 @@
+"""Cross-dtype consistency sweep (reference ``check_consistency``
+discipline, SURVEY.md §4: the same op in float16/bfloat16 must agree with
+its float32 run within a dtype-appropriate tolerance ladder).
+
+bf16 has ~3 decimal digits (8-bit mantissa): rtol 3e-2. fp16 has ~3.3
+digits (10-bit mantissa): rtol 1e-2.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import ndarray as nd
+
+rs = np.random.RandomState(7)
+
+S = rs.uniform(-0.8, 0.8, (4, 8)).astype(np.float32)
+P = rs.uniform(0.5, 1.5, (4, 8)).astype(np.float32)
+M = rs.uniform(-0.5, 0.5, (8, 8)).astype(np.float32)
+
+_TOL = {"float16": dict(rtol=1e-2, atol=1e-3),
+        "bfloat16": dict(rtol=4e-2, atol=4e-3)}
+
+OPS = [
+    ("sigmoid", lambda a, b, m: nd.sigmoid(a)),
+    ("tanh", lambda a, b, m: nd.tanh(a)),
+    ("gelu", lambda a, b, m: nd.gelu(a)),
+    ("relu", lambda a, b, m: nd.relu(a)),
+    ("exp", lambda a, b, m: nd.exp(a)),
+    ("log", lambda a, b, m: nd.log(b)),
+    ("sqrt", lambda a, b, m: nd.sqrt(b)),
+    ("rsqrt", lambda a, b, m: nd.rsqrt(b)),
+    ("square", lambda a, b, m: nd.square(a)),
+    ("softmax", lambda a, b, m: nd.softmax(a, axis=-1)),
+    ("log_softmax", lambda a, b, m: nd.log_softmax(a, axis=-1)),
+    ("sum", lambda a, b, m: nd.sum(a, axis=1)),
+    ("mean", lambda a, b, m: nd.mean(a, axis=0)),
+    ("max", lambda a, b, m: nd.max(a, axis=1)),
+    ("cumsum", lambda a, b, m: nd.cumsum(a, axis=1)),
+    ("dot", lambda a, b, m: nd.dot(m, m)),
+    ("elemwise_mul", lambda a, b, m: nd.elemwise_mul(a, a)),
+    ("broadcast_maximum", lambda a, b, m: nd.broadcast_maximum(a, b)),
+    ("LayerNorm", lambda a, b, m: nd.LayerNorm(
+        a, mx.nd.ones((8,), dtype=a.dtype),
+        mx.nd.zeros((8,), dtype=a.dtype), axis=-1)),
+    ("erf", lambda a, b, m: nd.erf(a)),
+    ("clip", lambda a, b, m: a.clip(-0.5, 0.5)),
+    ("transpose", lambda a, b, m: nd.transpose(a)),
+    ("tril", lambda a, b, m: nd.tril(m)),
+]
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+@pytest.mark.parametrize("name,op", OPS, ids=[c[0] for c in OPS])
+def test_dtype_consistent_with_f32(name, op, dtype):
+    def run(dt):
+        a = mx.nd.array(S, dtype=dt)
+        b = mx.nd.array(P, dtype=dt)
+        m = mx.nd.array(M, dtype=dt)
+        return op(a, b, m).asnumpy().astype(np.float64)
+
+    ref = run("float32")
+    got = run(dtype)
+    np.testing.assert_allclose(got, ref, **_TOL[dtype])
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_dense_train_step_dtype(dtype):
+    """A whole hybridized train step in reduced precision stays close to
+    the f32 step (bf16 MXU path sanity)."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    def run(dt):
+        mx.random.seed(11)
+        np.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(3, in_units=16))
+        net.initialize(init="xavier")
+        if dt != "float32":
+            net.cast(dt)
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        x = mx.nd.array(rs.rand(16, 8).astype(np.float32), dtype=dt)
+        y = mx.nd.array(rs.randint(0, 3, (16,)).astype(np.float32))
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        losses = []
+        for _ in range(3):
+            with mx.autograd.record():
+                loss = ce(net(x), y)
+            loss.backward()
+            tr.step(16)
+            losses.append(float(loss.mean().asscalar()))
+        return losses
+
+    ref = run("float32")
+    got = run(dtype)
+    np.testing.assert_allclose(got, ref, rtol=6e-2, atol=6e-2)
