@@ -12,6 +12,8 @@ image modules (SURVEY.md §2.1 "C++ data pipeline").
 
 from __future__ import annotations
 
+import os
+
 import threading
 from collections import OrderedDict, namedtuple
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -357,7 +359,7 @@ class ImageRecordIter(DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, part_index=0, num_parts=1,
-                 preprocess_threads=4, prefetch_buffer=64, resize=-1,
+                 preprocess_threads=None, prefetch_buffer=64, resize=-1,
                  rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, seed=0,
                  **kwargs):
@@ -372,6 +374,9 @@ class ImageRecordIter(DataIter):
         self._rand_crop = rand_crop
         self._part_index = part_index
         self._num_parts = num_parts
+        if preprocess_threads is None:
+            # decode threads beyond the core count only add contention
+            preprocess_threads = max(1, os.cpu_count() or 1)
         self._threads = preprocess_threads
         self._prefetch = prefetch_buffer
         self._rand_mirror = rand_mirror
