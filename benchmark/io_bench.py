@@ -45,6 +45,10 @@ def run_iter(path: str, batch: int, size: int, use_native: bool) -> float:
     it = mxio.ImageRecordIter(
         path_imgrec=path, data_shape=(3, size, size), batch_size=batch,
         shuffle=False)
+    if use_native:
+        assert it._native is not None, (
+            "native library unavailable — build with `make -C native` "
+            "(refusing to mislabel the pure-Python path as native)")
     if not use_native:
         # force the pure-Python fallback path
         if it._native is not None:
@@ -56,7 +60,7 @@ def run_iter(path: str, batch: int, size: int, use_native: bool) -> float:
     n_img = 0
     t0 = time.perf_counter()
     for batch_data in it:
-        n_img += batch_data.data[0].shape[0]
+        n_img += batch_data.data[0].shape[0] - batch_data.pad
     dt = time.perf_counter() - t0
     return n_img / dt
 

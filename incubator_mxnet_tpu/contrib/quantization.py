@@ -98,6 +98,72 @@ class QuantizedDense(HybridBlock):
                       differentiable=False)
 
 
+@register("quantized_conv", differentiable=False)
+def quantized_conv(x_q, w_q, x_scale=None, w_scale=None, bias=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                   num_group=1):
+    """int8 x int8 -> int32 convolution (reference quantized_conv — the
+    cuDNN/oneDNN int8 conv analog): NCHW/OIHW, int32 accumulation on the
+    MXU, per-output-channel dequantize + bias in the epilogue."""
+    acc = jax.lax.conv_general_dilated(
+        x_q, w_q, window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate), feature_group_count=num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(1, -1, 1, 1))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+class QuantizedConv2D(HybridBlock):
+    """Int8-weight Conv2D with calibrated activation quantization
+    (reference quantized_conv + requantize path)."""
+
+    def __init__(self, conv, a_min: float, a_max: float, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        w = conv.weight.data().asnumpy()           # (O, I/g, kh, kw)
+        w_scale = np.maximum(
+            np.abs(w).reshape(w.shape[0], -1).max(axis=1), 1e-8) / 127.0
+        self._wq = jnp.asarray(
+            np.clip(np.round(w / w_scale[:, None, None, None]),
+                    -127, 127), jnp.int8)
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)
+        self._bias = None
+        if getattr(conv, "bias", None) is not None:
+            self._bias = jnp.asarray(conv.bias.data().asnumpy())
+        self._a_absmax = float(max(abs(a_min), abs(a_max), 1e-8))
+        self._stride = tuple(conv._strides)
+        self._pad = tuple(conv._padding)
+        self._dilate = tuple(conv._dilation)
+        self._groups = int(getattr(conv, "_groups", 1))
+        self._act = getattr(conv, "_act", None)
+
+    def forward(self, x, *args):
+        wq, w_scale, bias = self._wq, self._w_scale, self._bias
+        a_scale = self._a_absmax / 127.0
+        stride, pad, dilate = self._stride, self._pad, self._dilate
+        groups, act = self._groups, self._act
+
+        def fn(xd):
+            xq = jnp.clip(jnp.round(xd / a_scale), -127, 127
+                          ).astype(jnp.int8)
+            out = quantized_conv(
+                xq, wq, x_scale=jnp.float32(a_scale), w_scale=w_scale,
+                bias=bias, stride=stride, pad=pad, dilate=dilate,
+                num_group=groups)
+            if act is not None:
+                from ..ops.nn import _ACTS
+
+                out = _ACTS[act](out)
+            return out
+
+        return invoke(fn, [x], name="quantized_conv",
+                      differentiable=False)
+
+
 class _CalibCollector:
     def __init__(self):
         self.ranges: Dict[int, List[float]] = {}
@@ -118,7 +184,7 @@ class _CalibCollector:
 def quantize_model(net, calib_data=None, quantized_dtype="int8",
                    exclude_blocks=()):
     """Calibrate activation ranges over ``calib_data`` batches, then
-    replace every calibrated Dense with a QuantizedDense (reference
+    replace every calibrated Dense/Conv2D with its int8 version (reference
     ``quantize_model`` minmax calibration). Returns a new net sharing
     unquantized children."""
     if quantized_dtype != "int8":
@@ -128,7 +194,8 @@ def quantize_model(net, calib_data=None, quantized_dtype="int8",
     reactivate = []
 
     def attach(b):
-        if isinstance(b, _nn.Dense) and b not in exclude_blocks:
+        if isinstance(b, (_nn.Dense, _nn.Conv2D)) and \
+                b not in exclude_blocks:
             dense_blocks.append(b)
             b.register_forward_pre_hook(collector.hook)
         # calibration must run EAGERLY: a warmed CachedOp would replay the
@@ -155,7 +222,10 @@ def quantize_model(net, calib_data=None, quantized_dtype="int8",
         for name, child in list(block._children.items()):
             if id(child) in collector.ranges:
                 lo, hi = collector.ranges[id(child)]
-                q = QuantizedDense(child, lo, hi)
+                if isinstance(child, _nn.Conv2D):
+                    q = QuantizedConv2D(child, lo, hi)
+                else:
+                    q = QuantizedDense(child, lo, hi)
                 block._children[name] = q
                 setattr(block, name, q)
             else:

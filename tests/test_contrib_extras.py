@@ -191,3 +191,27 @@ def test_onnx_export_import_roundtrip(tmp_path):
     y1 = fn(x)
     np.testing.assert_allclose(y1.asnumpy(), y0.asnumpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_quantize_model_conv2d_int8():
+    """quantize_model converts Conv2D layers; int8 conv tracks the fp32
+    net within quantization error (reference quantized_conv row)."""
+    from incubator_mxnet_tpu.contrib.quantization import (QuantizedConv2D,
+                                                          quantize_model)
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3, activation="relu"),
+            nn.Conv2D(4, 1, in_channels=8))
+    net.initialize(init="xavier")
+    x = mx.nd.uniform(shape=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+
+    qnet = quantize_model(net, calib_data=[x])
+    assert any(isinstance(c, QuantizedConv2D)
+               for c in qnet._children.values())
+    got = qnet(x).asnumpy()
+    # int8 per-channel weights + calibrated activations: ~1% relative
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.05, err
