@@ -79,6 +79,7 @@ _UNARY = [
     "np_resize", "vander", "unique", "nonzero", "flatnonzero", "argwhere",
     "bincount", "histogram", "partition_op", "np_partition",
     "argpartition", "atleast_2d", "atleast_3d", "lexsort",
+    "relu6", "hard_swish", "hardswish",
     # fft/complex wave (ops/fft_ops.py)
     "fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn", "ifftn",
     "fftshift", "ifftshift", "real", "imag", "conj", "angle",
@@ -566,3 +567,20 @@ def Custom(*data, op_type=None, **kwargs):
     if op_type is None:
         raise ValueError("Custom requires op_type=")
     return invoke_custom(op_type, list(data), kwargs)
+
+
+# --- reference legacy spellings (CamelCase op names + snake aliases) --------
+Cast = cast                      # noqa: F821  (defined via _wrap above)
+Reshape = reshape                # noqa: F821
+Flatten = lambda data: reshape(data, shape=(data.shape[0], -1))
+Concat = concat                  # noqa: F821
+SliceChannel = split             # noqa: F821
+slice_channel = split            # noqa: F821
+block_grad = BlockGrad if "BlockGrad" in dir() else None
+if block_grad is None:
+    from .ndarray import invoke_op as _iv
+
+    def block_grad(data):
+        return _iv("stop_gradient_op", data)
+    BlockGrad = block_grad
+SwapAxis = swapaxes              # noqa: F821

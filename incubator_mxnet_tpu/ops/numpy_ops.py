@@ -524,3 +524,15 @@ def clip_by_global_norm(*arrays, max_norm=1.0):
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
     out = tuple(a * scale.astype(a.dtype) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+# --- legacy-spelling activation completions ---------------------------------
+
+@register("relu6")
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@register("hard_swish", aliases=("hardswish",))
+def hard_swish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0

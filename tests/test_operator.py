@@ -293,3 +293,29 @@ def test_sdpa():
         mx.nd.array(q), mx.nd.array(q), mx.nd.array(q), causal=True)
     assert_almost_equal(outc.asnumpy()[:, :, 0], q[:, :, 0], rtol=1e-4,
                         atol=1e-5)
+
+
+def test_legacy_spelling_aliases():
+    """CamelCase reference op spellings (Cast/Reshape/Flatten/Concat/
+    SliceChannel/SwapAxis/BlockGrad) resolve to the canonical ops."""
+    a = mx.nd.array(np.random.RandomState(0).rand(2, 3, 4)
+                    .astype(np.float32))
+    assert nd.Flatten(a).shape == (2, 12)
+    assert str(nd.Cast(a, dtype="float16").dtype) == "float16"
+    assert nd.Reshape(a, shape=(6, 4)).shape == (6, 4)
+    assert nd.Concat(a, a, dim=0).shape == (4, 3, 4)
+    parts = nd.SliceChannel(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    assert nd.SwapAxis(a, 0, 1).shape == (3, 2, 4)
+    np.testing.assert_allclose(nd.relu6(a * 10).asnumpy().max(), 6.0)
+    h = nd.hard_swish(a)
+    np.testing.assert_allclose(
+        h.asnumpy(), a.asnumpy() * np.clip(a.asnumpy() + 3, 0, 6) / 6,
+        rtol=1e-6)
+    # BlockGrad stops gradients
+    x = mx.nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (nd.BlockGrad(x) * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones(3))
