@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Pipeline-parallel training example (GPipe microbatch schedule).
+
+Four identical stages sharded over the ``pipe`` mesh axis, composed with
+data parallelism; backward is the transposed pipeline (see
+parallel/pipeline.py).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/parallel/train_pipeline.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    n_dev = len(jax.devices())
+    S = 4 if n_dev % 4 == 0 and n_dev >= 4 else 1
+    if S == 1:
+        print("needs >=4 devices for a real pipeline; "
+              "set JAX_PLATFORMS=cpu XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8")
+    D, C = 32, 10
+
+    stages = []
+    for _ in range(max(S, 1)):
+        blk = nn.Dense(D, in_units=D, activation="tanh")
+        blk.initialize(init="xavier")
+        blk(mx.nd.zeros((1, D)))
+        stages.append(blk)
+    head = nn.Dense(C, in_units=D)
+    head.initialize(init="xavier")
+    head(mx.nd.zeros((1, D)))
+
+    mesh = parallel.make_mesh({"pipe": S, "data": n_dev // S})
+    trainer = parallel.PipelineTrainer(
+        stages, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 3e-3}, mesh=mesh, epilogue=head,
+        num_microbatches=2 * S)
+
+    rs = np.random.RandomState(0)
+    W = rs.randn(D, C).astype(np.float32)
+    for step in range(60):
+        x = rs.rand(64, D).astype(np.float32)
+        y = (x @ W).argmax(1).astype(np.float32)
+        loss = trainer.step(x, y)
+        if step % 10 == 0:
+            print(f"step {step:3d} loss {float(loss):.4f}")
+    print("final loss", float(loss))
+
+
+if __name__ == "__main__":
+    main()
