@@ -584,3 +584,12 @@ if block_grad is None:
         return _iv("stop_gradient_op", data)
     BlockGrad = block_grad
 SwapAxis = swapaxes              # noqa: F821
+SequenceMask = sequence_mask     # noqa: F821
+SequenceLast = sequence_last     # noqa: F821
+SequenceReverse = sequence_reverse  # noqa: F821
+
+
+def SoftmaxActivation(data, mode="instance"):
+    """Deprecated reference op (softmax over channels or instances)."""
+    axis = 1 if mode == "channel" else -1
+    return softmax(data, axis=axis)  # noqa: F821
