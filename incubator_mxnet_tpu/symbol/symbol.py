@@ -297,6 +297,25 @@ class Symbol:
                 out.append(n)
         return out
 
+    def optimize_for(self, backend: str = "TPU", **kwargs) -> "Symbol":
+        """Apply registered subgraph partitioners (reference
+        ``Symbol.optimize_for(backend)`` → BuildSubgraph pass). Known
+        backends: 'TPU'/'default' run every registered property (conv+BN
+        folding); a property name runs just that one."""
+        from .partition import _PROPERTIES, partition_graph
+
+        if backend in ("TPU", "default", "ALL"):
+            # longest pattern first so conv+bn+act wins over conv+bn
+            props = sorted(_PROPERTIES.values(),
+                           key=lambda pr: -len(pr.pattern))
+        elif backend in _PROPERTIES:
+            props = [_PROPERTIES[backend]]
+        else:
+            raise ValueError(
+                f"unknown backend {backend!r}; registered: "
+                f"{sorted(_PROPERTIES)} (or 'TPU' for all)")
+        return partition_graph(self, props)
+
     def get_internals(self) -> "Symbol":
         """All intermediate outputs as a group (reference
         ``Symbol.get_internals``; used for feature extraction and

@@ -215,3 +215,20 @@ def test_quantize_model_conv2d_int8():
     # int8 per-channel weights + calibrated activations: ~1% relative
     err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-8)
     assert err < 0.05, err
+
+
+def test_symbol_optimize_for():
+    """Symbol.optimize_for (reference BuildSubgraph entry point) applies
+    registered partitioners, longest pattern first."""
+    import incubator_mxnet_tpu.symbol as sym
+
+    x = sym.var("data")
+    h = sym.Convolution(x, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                        name="c1")
+    h = sym.BatchNorm(h, name="bn1")
+    h = sym.Activation(h, act_type="relu", name="a1")
+    opt = h.optimize_for("TPU")
+    names = [n.op for n, _ in opt.get_internals()._entries if n.op]
+    assert names == ["_fused_conv_bn"]
+    with pytest.raises(ValueError, match="unknown backend"):
+        h.optimize_for("tensorrt")
