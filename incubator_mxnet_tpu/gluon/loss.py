@@ -363,3 +363,30 @@ class CTCLoss(Loss):
             return fn(p, l, pl, ll)
 
         return invoke(dispatch, args, name="ctc_loss")
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference ``gluon.loss.
+    SDMLLoss``): batchwise smoothed cross-entropy over the pairwise
+    l2-distance matrix between two batches of embeddings, where the
+    diagonal pairs are positives."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smooth = smoothing_parameter
+
+    def forward(self, x1, x2):
+        from . import nn as _  # noqa: F401  (keep import side effects)
+        from .. import ndarray as F
+
+        n = x1.shape[0]
+        # pairwise squared l2 distances (n, n)
+        d = ((x1.expand_dims(1) - x2.expand_dims(0)) ** 2).sum(axis=2)
+        # smoothed targets: 1-eps on the diagonal, eps/(n-1) elsewhere
+        eye = F.one_hot(F.arange(0, n, dtype="int32"), n)
+        smooth = self._smooth
+        target = eye * (1.0 - smooth) + (1.0 - eye) * (
+            smooth / max(n - 1, 1))
+        logprob = F.log_softmax(-d, axis=1)
+        return -(target * logprob).sum(axis=1) * self._weight

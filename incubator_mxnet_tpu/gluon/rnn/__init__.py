@@ -1,6 +1,8 @@
 """Recurrent layers (reference ``python/mxnet/gluon/rnn/``)."""
 
 from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell, HybridRecurrentCell,
+                       ModifierCell,
                        LSTMCell, RNNCell, RecurrentCell, ResidualCell,
                        SequentialRNNCell, ZoneoutCell)
+HybridSequentialRNNCell = SequentialRNNCell  # cells are hybrid natively
 from .rnn_layer import GRU, LSTM, RNN

@@ -248,6 +248,23 @@ class DropoutCell(RecurrentCell):
         return F.Dropout(x, p=self._rate), states
 
 
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference ``ModifierCell``:
+    Zoneout/Residual subclass it). Delegates state handling to the base
+    cell."""
+
+    def __init__(self, base_cell, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)             if func is not None else self.base_cell.begin_state(
+                batch_size, **kwargs)
+
+
 class ZoneoutCell(RecurrentCell):
     """Zoneout regularization wrapper (reference ``ZoneoutCell``)."""
 
