@@ -377,7 +377,6 @@ class SDMLLoss(Loss):
         self._smooth = smoothing_parameter
 
     def forward(self, x1, x2):
-        from . import nn as _  # noqa: F401  (keep import side effects)
         from .. import ndarray as F
 
         n = x1.shape[0]

@@ -265,22 +265,15 @@ class ModifierCell(RecurrentCell):
                 batch_size, **kwargs)
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
     """Zoneout regularization wrapper (reference ``ZoneoutCell``)."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
                  prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.base_cell = base_cell
+        super().__init__(base_cell, prefix=prefix, params=params)
         self._zo = zoneout_outputs
         self._zs = zoneout_states
         self._prev_output = None
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
-    def begin_state(self, batch_size=0, **kwargs):
-        return self.base_cell.begin_state(batch_size, **kwargs)
 
     def forward(self, x, states):
         from ... import ndarray as F
@@ -308,17 +301,7 @@ class ZoneoutCell(RecurrentCell):
         self.base_cell.reset()
 
 
-class ResidualCell(RecurrentCell):
-    def __init__(self, base_cell, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.base_cell = base_cell
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
-    def begin_state(self, batch_size=0, **kwargs):
-        return self.base_cell.begin_state(batch_size, **kwargs)
-
+class ResidualCell(ModifierCell):
     def forward(self, x, states):
         out, states = self.base_cell(x, states)
         return out + x, states

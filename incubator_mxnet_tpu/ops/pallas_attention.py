@@ -47,8 +47,9 @@ def pallas_available() -> bool:
         return False
 
 
-def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bq, bk, t_k,
-                      t_valid, tq_valid, scale, causal, n_heads):
+def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref=None,
+                      *, bq, bk, t_k, t_valid, tq_valid, scale, causal,
+                      n_heads):
     from jax import lax
 
     qi = q_ref[0]                                # native dtype: bf16 stays
@@ -114,6 +115,14 @@ def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bq, bk, t_k,
 
     out = acc / jnp.maximum(l, 1e-37)
     o_ref[0] = out.astype(o_ref.dtype)
+    if lse_ref is not None:
+        # log-sum-exp per query row (flash-decoding merge statistic);
+        # fully-masked rows get -inf so partial merges ignore them
+        lse = jnp.where(l[:, 0] > 0,
+                        jnp.where(jnp.isfinite(m[:, 0]), m[:, 0], 0.0)
+                        + jnp.log(jnp.maximum(l[:, 0], 1e-37)),
+                        -jnp.inf)
+        lse_ref[0] = lse.astype(jnp.float32)
 
 
 def _pl():
@@ -122,7 +131,8 @@ def _pl():
     return pl
 
 
-def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512):
+def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512,
+               return_lse=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -149,18 +159,34 @@ def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512):
     kernel = functools.partial(
         _flash_fwd_kernel, bq=bq, bk=bk, t_k=tkp, t_valid=tk, tq_valid=tq,
         scale=scale, causal=causal, n_heads=h)
+    in_specs = [
+        pl.BlockSpec((b,), lambda bi, i: (0,),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0)),
+        pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0)),
+        pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, tqp, d), q.dtype)
+    if return_lse:
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b * h, tqp // bq),
+            in_specs=in_specs,
+            out_specs=[o_spec,
+                       pl.BlockSpec((1, bq), lambda bi, i: (bi, i))],
+            out_shape=[o_shape,
+                       jax.ShapeDtypeStruct((b * h, tqp), jnp.float32)],
+            interpret=interpret,
+        )(lens, qf, kf, vf)
+        return (out.reshape(b, h, tqp, d)[:, :, :tq, :],
+                lse.reshape(b, h, tqp)[:, :, :tq])
     out = pl.pallas_call(
         kernel,
         grid=(b * h, tqp // bq),
-        in_specs=[
-            pl.BlockSpec((b,), lambda bi, i: (0,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tqp, d), q.dtype),
+        in_specs=in_specs,
+        out_specs=o_spec,
+        out_shape=o_shape,
         interpret=interpret,
     )(lens, qf, kf, vf)
     return out.reshape(b, h, tqp, d)[:, :, :tq, :]

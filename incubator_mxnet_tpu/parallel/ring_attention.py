@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -90,17 +91,81 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Flash-decoding merge of two normalized attention partials with
+    their log-sum-exp statistics."""
+    m = jnp.maximum(lse1, lse2)
+    ms = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(lse1 - ms), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(lse2 - ms), 0.0)
+    tot = jnp.maximum(w1 + w2, 1e-37)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / tot[..., None]
+    lse = ms + jnp.log(tot)
+    lse = jnp.where(jnp.isfinite(m), lse, -jnp.inf)
+    return o, lse
+
+
+def ring_attention_pallas(q, k, v, axis_name: str = SEQ_AXIS,
+                          causal: bool = False,
+                          interpret: Optional[bool] = None):
+    """Ring attention with the Pallas flash kernel as the per-shard block
+    engine (SURVEY §2.4 CP row: "Pallas ring-attention / blockwise
+    attention over ICI ring").
+
+    Each rotation runs the compiled flash kernel over (q_local, kv_blk)
+    emitting (out, lse); partials merge flash-decoding style. The ring is
+    a static python loop (n is the mesh-axis size), so the diagonal
+    rotation uses the kernel's causal path and off-diagonal visibility is
+    a traced whole-block weight.
+
+    Forward-optimized (inference / frozen-attention); the jnp ring path
+    stays the differentiable one.
+    """
+    from ..ops.pallas_attention import _flash_fwd
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+
+    o_acc = jnp.zeros(q.shape, jnp.float32)
+    lse_acc = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    k_blk, v_blk = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for i in range(n):
+        # i rotations back: these keys came from shard (my - i) mod n
+        o_blk, lse_blk = _flash_fwd(
+            q, k_blk, v_blk, None, scale, causal and i == 0, interpret,
+            return_lse=True)
+        if causal and i > 0:
+            # src < my -> block fully visible; src > my (wrap) -> hidden
+            visible = my >= i
+            lse_blk = jnp.where(visible, lse_blk, -jnp.inf)
+        o_acc, lse_acc = _merge_partials(o_acc, lse_acc,
+                                         o_blk.astype(jnp.float32),
+                                         lse_blk)
+        if i + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
-                           causal: bool = False):
+                           causal: bool = False, impl: str = "xla"):
     """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; T is sharded
-    over ``axis_name`` of ``mesh``."""
+    over ``axis_name`` of ``mesh``. ``impl='pallas'`` runs the flash
+    kernel per ring block (forward-optimized); ``'xla'`` is the
+    differentiable streaming-softmax path."""
     from jax import shard_map
 
     spec = P(None, None, axis_name, None)
 
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
+    inner = ring_attention_pallas if impl == "pallas" else ring_attention
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name,
-                          causal=causal),
+        functools.partial(inner, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
