@@ -194,7 +194,8 @@ def ring_attention_nd(q, k, v, mask=None):
 
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
-                      causal: bool = False):
+                      causal: bool = False, impl: str = "xla",
+                      interpret: Optional[bool] = None):
     """DeepSpeed-Ulysses: all-to-all so each device sees the FULL sequence
     for H/n heads, computes dense attention, then scatters back.
 
@@ -221,6 +222,16 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
         return x.reshape(b, h, t_local, d)
 
     qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if impl == "pallas":
+        # full-sequence flash kernel per head-group (each device holds the
+        # whole sequence after the head-scatter)
+        from ..ops.pallas_attention import _flash_fwd
+
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        of = _flash_fwd(qf, kf, vf, None, 1.0 / float(np.sqrt(d)),
+                        causal, interpret)
+        return gather_heads(of)
     scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
     if causal:
@@ -234,13 +245,15 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh,
                               axis_name: str = SEQ_AXIS,
-                              causal: bool = False):
+                              causal: bool = False, impl: str = "xla"):
     from jax import shard_map
 
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,
-                          causal=causal),
+                          causal=causal, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

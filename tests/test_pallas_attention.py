@@ -135,3 +135,29 @@ def test_ring_attention_pallas_matches_xla_ring():
             q, k, v, mesh, causal=causal, impl="pallas"))
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5,
                                    err_msg=f"causal={causal}")
+
+
+def test_ulysses_pallas_matches_xla():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.ring_attention import (
+        ulysses_attention_sharded)
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = parallel.make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    rs = np.random.RandomState(4)
+    B, H, T, D = 2, 4, 64, 16
+    q = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+    for causal in (False, True):
+        ref = np.asarray(ulysses_attention_sharded(
+            q, k, v, mesh, causal=causal, impl="xla"))
+        got = np.asarray(ulysses_attention_sharded(
+            q, k, v, mesh, causal=causal, impl="pallas"))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"causal={causal}")
