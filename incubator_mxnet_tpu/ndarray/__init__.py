@@ -79,7 +79,9 @@ _UNARY = [
     "np_resize", "vander", "unique", "nonzero", "flatnonzero", "argwhere",
     "bincount", "histogram", "partition_op", "np_partition",
     "argpartition", "atleast_2d", "atleast_3d", "lexsort",
-    "relu6", "hard_swish", "hardswish",
+    "relu6", "hard_swish", "hardswish", "cov", "corrcoef", "nanmedian",
+    "nanquantile", "nanpercentile", "unwrap", "gradient_op", "np_gradient",
+    "packbits", "unpackbits",
     # fft/complex wave (ops/fft_ops.py)
     "fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn", "ifftn",
     "fftshift", "ifftshift", "real", "imag", "conj", "angle",
@@ -108,9 +110,10 @@ _BINARY = [
     "isclose", "array_equal", "kron", "outer", "inner", "vdot",
     "tensordot", "cross", "polyval", "trapz", "convolve", "correlate",
     "searchsorted", "digitize", "setdiff1d", "intersect1d", "union1d",
-    "isin", "linalg_solve", "linalg_tensorsolve",
+    "isin", "linalg_solve", "linalg_tensorsolve", "take_along_axis",
+    "fmax", "fmin", "compress_op", "np_compress", "extract", "select",
 ]
-_TERNARY = ["where", "scatter_nd", "interp"]
+_TERNARY = ["where", "scatter_nd", "interp", "put_along_axis"]
 _VARIADIC = ["concat", "concatenate", "stack", "khatri_rao",
              "hstack", "vstack", "dstack", "column_stack",
              "meshgrid", "broadcast_arrays", "einsum",

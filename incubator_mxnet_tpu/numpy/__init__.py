@@ -85,6 +85,8 @@ _PASSTHROUGH = [
     # dynamic-shape (eager)
     "unique", "nonzero", "flatnonzero", "argwhere", "bincount",
     "histogram", "setdiff1d", "intersect1d", "union1d", "isin", "interp",
+    "take_along_axis", "cov", "corrcoef", "nanmedian", "nanquantile",
+    "nanpercentile", "unwrap", "fmax", "fmin", "extract",
     # misc
     "gather_nd", "real", "imag", "conj", "angle",
 ]

@@ -536,3 +536,94 @@ def relu6(x):
 @register("hard_swish", aliases=("hardswish",))
 def hard_swish(x):
     return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+# --- second numpy completion wave -------------------------------------------
+
+@register("take_along_axis")
+def take_along_axis(a, indices, axis=-1):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32), axis=axis)
+
+
+@register("put_along_axis", differentiable=False)
+def put_along_axis(a, indices, values, axis=-1):
+    return jnp.put_along_axis(a, indices.astype(jnp.int32), values,
+                              axis=axis, inplace=False)
+
+
+@register("select")
+def select(condlist, choicelist, default=0.0):
+    # condlist/choicelist arrive stacked on a leading axis
+    conds = [condlist[i].astype(bool) for i in range(condlist.shape[0])]
+    choices = [choicelist[i] for i in range(choicelist.shape[0])]
+    return jnp.select(conds, choices, default=default)
+
+
+@register("compress_op", aliases=("np_compress",), differentiable=False)
+def compress_op(condition, a, axis=None):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.compress(np.asarray(condition).astype(bool),
+                                   np.asarray(a), axis=axis))
+
+
+@register("extract", differentiable=False)
+def extract(condition, a):
+    """Eager-only (data-dependent shape)."""
+    return jnp.asarray(np.extract(np.asarray(condition).astype(bool),
+                                  np.asarray(a)))
+
+
+@register("cov")
+def cov(x, rowvar=True, ddof=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof)
+
+
+@register("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register("nanmedian")
+def nanmedian(x, axis=None, keepdims=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdims)
+
+
+@register("nanquantile")
+def nanquantile(x, q=0.5, axis=None, keepdims=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdims)
+
+
+@register("nanpercentile")
+def nanpercentile(x, q=50.0, axis=None, keepdims=False):
+    return jnp.nanpercentile(x, q, axis=axis, keepdims=keepdims)
+
+
+@register("unwrap")
+def unwrap(x, axis=-1):
+    return jnp.unwrap(x, axis=axis)
+
+
+@register("gradient_op", aliases=("np_gradient",))
+def gradient_op(x, axis=None):
+    out = jnp.gradient(x, axis=axis)
+    return tuple(out) if isinstance(out, list) else out
+
+
+@register("fmax")
+def fmax(a, b):
+    return jnp.fmax(a, b)
+
+
+@register("fmin")
+def fmin(a, b):
+    return jnp.fmin(a, b)
+
+
+@register("packbits", differentiable=False)
+def packbits(x, axis=None):
+    return jnp.packbits(x.astype(jnp.uint8), axis=axis)
+
+
+@register("unpackbits", differentiable=False)
+def unpackbits(x, axis=None, count=None):
+    return jnp.unpackbits(x.astype(jnp.uint8), axis=axis, count=count)

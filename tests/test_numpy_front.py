@@ -293,3 +293,35 @@ def test_multi_output_linalg_backward():
     l2.backward()
     # d(sum of eigenvalues)/dA = I for symmetric A
     np.testing.assert_allclose(b.grad.asnumpy(), np.eye(6), atol=2e-4)
+
+
+def test_second_completion_wave():
+    a = mx.np.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(
+        mx.np.nanmedian(a).asnumpy(), 5.5)
+    np.testing.assert_allclose(
+        mx.np.corrcoef(a).asnumpy(),
+        np.corrcoef(np.arange(12.).reshape(3, 4)), rtol=1e-4)
+    np.testing.assert_allclose(
+        mx.np.take_along_axis(a, mx.np.array(
+            np.zeros((3, 1), np.int32)), -1).asnumpy(),
+        [[0], [4], [8]])
+    g = nd.gradient_op(a, axis=1)
+    np.testing.assert_allclose(
+        g.asnumpy(), np.gradient(np.arange(12.).reshape(3, 4), axis=1))
+    e = nd.extract(nd.array(np.array([1, 0, 1, 0], np.float32)),
+                   nd.array(np.arange(4, dtype=np.float32)))
+    np.testing.assert_array_equal(e.asnumpy(), [0, 2])
+    # put_along_axis (out-of-place)
+    out = nd.put_along_axis(a, nd.array(np.zeros((3, 1), np.float32)),
+                            nd.array(np.full((3, 1), 9.0, np.float32)),
+                            axis=-1)
+    assert out.asnumpy()[0, 0] == 9.0
+    # autograd through take_along_axis
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.take_along_axis(x, mx.nd.array(
+            np.zeros((3, 1), np.float32)), axis=-1).sum()
+    y.backward()
+    assert x.grad.asnumpy()[:, 0].sum() == 3.0
