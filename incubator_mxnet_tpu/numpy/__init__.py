@@ -221,6 +221,14 @@ def searchsorted(a, v, side="left"):
     return _nd.searchsorted(a, v, side=side)
 
 
+def take_along_axis(a, indices, axis=-1):
+    return _nd.take_along_axis(a, indices, axis=axis)
+
+
+def put_along_axis(a, indices, values, axis=-1):
+    return _nd.put_along_axis(a, indices, values, axis=axis)
+
+
 def einsum(subscripts, *operands):
     """numpy-style einsum (subscripts first)."""
     return _nd.invoke_op("einsum", *operands, subscripts=subscripts)
