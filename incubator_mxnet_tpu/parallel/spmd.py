@@ -136,7 +136,7 @@ class SPMDTrainer:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS,
-                 loss_has_aux_inputs: int = 1, donate: bool = True,
+                 donate: bool = True,
                  shard_weight_update: bool = False):
         self.net = net
         self.loss_fn = loss_fn
