@@ -104,3 +104,16 @@ def test_example_lstm_ptb_runs():
               "--seq-len", "8", "--batch-size", "4", "--iters", "3"])
     assert p.returncode == 0, p.stderr
     assert "perplexity" in p.stdout
+
+
+def test_example_moe_runs():
+    r = _run([os.path.join(REPO, "examples", "parallel", "train_moe.py")])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "final loss" in r.stdout
+
+
+def test_example_pipeline_runs():
+    r = _run([os.path.join(REPO, "examples", "parallel",
+                           "train_pipeline.py")])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "final loss" in r.stdout
