@@ -325,3 +325,39 @@ def test_second_completion_wave():
             np.zeros((3, 1), np.float32)), axis=-1).sum()
     y.backward()
     assert x.grad.asnumpy()[:, 0].sum() == 3.0
+
+
+def test_wave2_remaining_oracles():
+    x = np.array([0.0, 0.8, 6.5, 7.0], np.float32)   # wraps past pi
+    np.testing.assert_allclose(
+        nd.unwrap(nd.array(x)).asnumpy(), np.unwrap(x), rtol=1e-5)
+    a = rs.rand(3, 4).astype(np.float32)
+    a[0, 0] = np.nan
+    np.testing.assert_allclose(
+        float(nd.nanquantile(nd.array(a), q=0.5).asnumpy()),
+        np.nanquantile(a, 0.5), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nd.nanpercentile(nd.array(a), q=30).asnumpy()),
+        np.nanpercentile(a, 30), rtol=1e-5)
+    # select/compress/fmin on a nan-free matrix
+    a = rs.rand(3, 4).astype(np.float32)
+    conds = np.stack([a < 0.3, a > 0.7]).astype(np.float32)
+    choices = np.stack([a * 0, a * 2])
+    np.testing.assert_allclose(
+        nd.select(nd.array(conds), nd.array(choices), default=-1.0
+                  ).asnumpy(),
+        np.select([a < 0.3, a > 0.7], [a * 0, a * 2], default=-1.0),
+        rtol=1e-6)
+    bits = np.array([1, 0, 1, 1, 0, 0, 0, 1], np.float32)
+    np.testing.assert_array_equal(
+        nd.packbits(nd.array(bits)).asnumpy(),
+        np.packbits(bits.astype(np.uint8)))
+    np.testing.assert_array_equal(
+        nd.unpackbits(nd.packbits(nd.array(bits))).asnumpy(),
+        bits.astype(np.uint8))
+    c = nd.compress_op(nd.array(np.array([1, 0, 1], np.float32)),
+                       nd.array(a[:3]), axis=0)
+    np.testing.assert_allclose(c.asnumpy(), a[[0, 2]], rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.fmin(nd.array(a), nd.array(a * 0 + 0.5)).asnumpy(),
+        np.fmin(a, 0.5), rtol=1e-6)
