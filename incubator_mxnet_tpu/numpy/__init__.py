@@ -297,3 +297,34 @@ bool_ = _onp.bool_
 from . import random  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
+
+
+def promote_types(t1, t2):
+    return _onp.promote_types(t1, t2)
+
+
+def result_type(*args):
+    return _onp.result_type(*[
+        a.dtype if isinstance(a, ndarray) else a for a in args])
+
+
+def can_cast(from_, to, casting="safe"):
+    if isinstance(from_, ndarray):
+        from_ = from_.dtype
+    return _onp.can_cast(from_, to, casting=casting)
+
+
+def issubdtype(arg1, arg2):
+    return _onp.issubdtype(arg1, arg2)
+
+
+def shape(a):
+    return a.shape
+
+
+def ndim(a):
+    return a.ndim
+
+
+def size(a, axis=None):
+    return a.shape[axis] if axis is not None else a.size

@@ -361,3 +361,13 @@ def test_wave2_remaining_oracles():
     np.testing.assert_allclose(
         nd.fmin(nd.array(a), nd.array(a * 0 + 0.5)).asnumpy(),
         np.fmin(a, 0.5), rtol=1e-6)
+
+
+def test_np_dtype_helpers():
+    a = mx.np.array([[1.0, 2.0]])
+    assert mx.np.result_type(a, np.float64) == np.float64
+    assert mx.np.can_cast("int32", "float64")
+    assert mx.np.shape(a) == (1, 2)
+    assert mx.np.ndim(a) == 2
+    assert mx.np.size(a) == 2
+    assert mx.np.issubdtype(a.dtype, np.floating)
