@@ -13,6 +13,7 @@ import os
 from typing import List, Optional
 
 import numpy as np
+import jax.numpy as jnp
 
 from .io import DataBatch, DataDesc, DataIter
 from .ndarray import NDArray, array as nd_array
@@ -152,6 +153,148 @@ class CastAug(Augmenter):
     def __call__(self, src):
         a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
         return nd_array(a.astype(self.typ))
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop with area/aspect jitter (reference
+    ``image.random_size_crop`` — the RandomResizedCrop primitive)."""
+    import random as _pyrandom
+
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size          # (w, h)
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class _JitterAug(Augmenter):
+    """Multiplicative jitter base (reference brightness/contrast/
+    saturation jitter semantics)."""
+
+    def __init__(self, jitter):
+        self.jitter = jitter
+
+    def _alpha(self):
+        return 1.0 + float(np.random.uniform(-self.jitter, self.jitter))
+
+
+class BrightnessJitterAug(_JitterAug):
+    def __call__(self, src):
+        return src * self._alpha()
+
+
+class ContrastJitterAug(_JitterAug):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, src):
+        alpha = self._alpha()
+        gray = (src * NDArray(jnp.asarray(self._coef))).sum()             / (src.shape[0] * src.shape[1])
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class SaturationJitterAug(_JitterAug):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, src):
+        alpha = self._alpha()
+        gray = (src * NDArray(jnp.asarray(self._coef))).sum(
+            axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(_JitterAug):
+    """Hue rotation in YIQ space (reference HueJitterAug)."""
+
+    _yiq = np.array([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]], np.float32)
+    _yiq_inv = np.array([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = float(np.random.uniform(-self.jitter, self.jitter))
+        u, w_ = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]],
+                      np.float32)
+        t = self._yiq_inv @ bt @ self._yiq
+        arr = src.asnumpy()
+        return NDArray(jnp.asarray(arr @ t.T))
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style; reference LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src + NDArray(jnp.asarray(rgb.astype(np.float32)))
+
+
+class RandomGrayAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            gray = (src * NDArray(jnp.asarray(self._coef))).sum(
+                axis=2, keepdims=True)
+            return NDArray(jnp.broadcast_to(gray._data, src.shape))
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,

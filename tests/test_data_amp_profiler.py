@@ -331,3 +331,39 @@ def test_debug_nans_knob():
     out = (mx.nd.array(np.array([0.0])) / mx.nd.array(
         np.array([0.0]))).asnumpy()
     assert np.isnan(out).all()
+
+
+def test_image_jitter_augmenters():
+    """Round-3 augmenter completions (reference image.py jitter/lighting
+    augmenter family)."""
+    from incubator_mxnet_tpu import image
+
+    rs2 = np.random.RandomState(0)
+    img = mx.nd.array(rs2.rand(32, 48, 3).astype(np.float32))
+    np.random.seed(0)
+    out, rect = image.random_size_crop(img, (16, 16), (0.5, 1.0),
+                                       (0.75, 1.33))
+    assert out.shape == (16, 16, 3)
+    x0, y0, w, h = rect
+    assert 0 <= x0 and x0 + w <= 48 and 0 <= y0 and y0 + h <= 32
+
+    augs = [image.BrightnessJitterAug(0.3), image.ContrastJitterAug(0.3),
+            image.SaturationJitterAug(0.3), image.HueJitterAug(0.3),
+            image.RandomGrayAug(1.0),
+            image.LightingAug(0.1, np.ones(3),
+                              np.eye(3, dtype=np.float32)),
+            image.ForceResizeAug((24, 20))]
+    for aug in augs:
+        o = aug(img)
+        assert np.isfinite(o.asnumpy()).all(), type(aug).__name__
+    assert image.ForceResizeAug((24, 20))(img).shape == (20, 24, 3)
+    # gray: all channels equal
+    g = image.RandomGrayAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-6)
+    # hue jitter at zero magnitude is identity
+    np.random.seed(1)
+    h0 = image.HueJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(h0, img.asnumpy(), atol=1e-5)
+    comp = image.SequentialAug([image.BrightnessJitterAug(0.1),
+                                image.CastAug()])
+    assert comp(img).shape == img.shape
