@@ -360,10 +360,11 @@ def test_image_jitter_augmenters():
     # gray: all channels equal
     g = image.RandomGrayAug(1.0)(img).asnumpy()
     np.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-6)
-    # hue jitter at zero magnitude is identity
+    # hue jitter at zero magnitude is identity up to the rounded YIQ
+    # matrix constants (~3e-3)
     np.random.seed(1)
     h0 = image.HueJitterAug(0.0)(img).asnumpy()
-    np.testing.assert_allclose(h0, img.asnumpy(), atol=1e-5)
+    np.testing.assert_allclose(h0, img.asnumpy(), atol=5e-3)
     comp = image.SequentialAug([image.BrightnessJitterAug(0.1),
                                 image.CastAug()])
     assert comp(img).shape == img.shape
