@@ -13,12 +13,22 @@ environment): ResNet-50 ~800 img/s/A100 AMP (NGC-era), BERT-base phase-1
 VGG16 ~180 img/s/A100, MLP/MNIST ~500k img/s (trivially host-bound on GPU).
 
 The headline metric (ResNet-50, the north-star row) prints LAST.
+
+Flake-proofing (round 4): each config runs in its OWN subprocess and is
+retried on failure (fresh process, so a poisoned PJRT tunnel connection
+cannot leak into the next attempt or the next config). A transient
+``INTERNAL: ... remote_compile`` tunnel error erased the round-3 headline
+number; the retry loop exists so that can never happen again
+(`tests/test_bench_retry.py` injects such a fault and asserts recovery).
 """
 
 from __future__ import annotations
 
 import gc
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -237,22 +247,97 @@ def bench_resnet():
             "resnet50_v1_train_throughput_per_chip", "resnet50")
 
 
-def main():
-    # headline (resnet) runs and prints last
-    for fn in (bench_mlp, bench_lstm_ptb, bench_bert, bench_ssd,
-               bench_resnet):
+CONFIGS = {
+    "mlp": bench_mlp,
+    "lstm_ptb": bench_lstm_ptb,
+    "bert_base": bench_bert,
+    "ssd300": bench_ssd,
+    "resnet50": bench_resnet,  # headline — always last
+}
+
+ATTEMPTS = 3
+
+
+def run_one(key):
+    """Run a single config in-process; print its JSON line to stdout."""
+    fn = CONFIGS[key]
+    try:
+        value, unit, metric, _ = fn()
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 2),
+            "unit": unit,
+            "vs_baseline": round(value / ANCHORS[key], 4),
+        }), flush=True)
+        return 0
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"bench_{key}", "value": 0, "unit": "error",
+            "vs_baseline": 0, "error": str(e)[:200]}), flush=True)
+        return 1
+
+
+def _spawn(key):
+    """Run one config in a fresh interpreter; return (rc, last stdout line).
+
+    A fresh process per attempt is the point: the round-3 failure mode was
+    a broken tunnel HTTP stream inside the process, which no in-process
+    retry can recover from.
+    """
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--config", key],
+        capture_output=True, text=True, timeout=1800)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines and proc.stderr:
+        # child died before printing (import error, OOM kill, segfault):
+        # surface its stderr tail instead of throwing the traceback away
+        return proc.returncode or 1, json.dumps({
+            "metric": f"bench_{key}", "value": 0, "unit": "error",
+            "vs_baseline": 0,
+            "error": "no stdout; stderr tail: "
+                     + proc.stderr.strip()[-300:]})
+    return proc.returncode, (lines[-1] if lines else "")
+
+
+def run_config_with_retry(key, attempts=ATTEMPTS, runner=_spawn):
+    """Retry a config until it yields a real metric line; return the line.
+
+    Retries on: nonzero exit, no/unparseable JSON output, or an
+    ``unit == "error"`` line (the in-process handler converts tunnel
+    errors like ``INTERNAL: ... remote_compile`` into those). The last
+    attempt's line is returned even if it is an error line, so the driver
+    still records *something* for the config.
+    """
+    line = ""
+    for attempt in range(1, attempts + 1):
         try:
-            value, unit, metric, key = fn()
-            print(json.dumps({
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": unit,
-                "vs_baseline": round(value / ANCHORS[key], 4),
-            }), flush=True)
-        except Exception as e:  # one failing config must not hide the rest
-            print(json.dumps({
-                "metric": fn.__name__, "value": 0, "unit": "error",
-                "vs_baseline": 0, "error": str(e)[:200]}), flush=True)
+            rc, line = runner(key)
+        except Exception as e:  # subprocess timeout/crash
+            rc, line = 1, json.dumps({
+                "metric": f"bench_{key}", "value": 0, "unit": "error",
+                "vs_baseline": 0, "error": str(e)[:200]})
+        ok = False
+        if rc == 0 and line:
+            try:
+                ok = json.loads(line).get("unit") != "error"
+            except ValueError:
+                ok = False
+        if ok:
+            return line
+        print(f"[bench] {key} attempt {attempt}/{attempts} failed: "
+              f"{line[:160]}", file=sys.stderr, flush=True)
+    return line or json.dumps({
+        "metric": f"bench_{key}", "value": 0, "unit": "error",
+        "vs_baseline": 0, "error": "no output from any attempt"})
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) >= 2 and argv[0] == "--config":
+        sys.exit(run_one(argv[1]))
+    # driver mode: never imports jax itself; headline (resnet) prints last
+    for key in CONFIGS:
+        print(run_config_with_retry(key), flush=True)
         gc.collect()
 
 

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Fused Pallas conv+BN kernel vs the XLA conv->BN chain, per ResNet-50
+conv shape, on the real chip.
+
+Two measurements per shape (forward semantics, training BN):
+  xla   — lax.conv (bf16, fp32 acc) -> per-channel mean/var stat pass ->
+          normalize+relu apply pass (what the model does today)
+  fused — Pallas fused_conv_bn (prologue BN+relu of the PREVIOUS layer +
+          conv + stats epilogue) — one HBM round-trip
+
+Timing: on-device lax.fori_loop over ITERS applications with a carried
+dependency, one device_get sync (per-step sync through the axon tunnel
+costs ~100 ms — see PROFILE.md).
+
+Usage: python benchmark/fused_conv_bench.py [--iters 20] [--batch 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# (name, H, Ci, Co, k, stride) — ResNet-50 body shapes (NHWC)
+SHAPES = [
+    ("l1.1x1a", 56, 64, 64, 1, 1),
+    ("l1.3x3", 56, 64, 64, 3, 1),
+    ("l1.1x1b", 56, 64, 256, 1, 1),
+    ("l2.3x3", 28, 128, 128, 3, 1),
+    ("l2.1x1b", 28, 128, 512, 1, 1),
+    ("l2.down", 56, 256, 512, 1, 2),
+    ("l3.3x3", 14, 256, 256, 3, 1),
+    ("l3.1x1b", 14, 256, 1024, 1, 1),
+    ("l4.3x3", 7, 512, 512, 3, 1),
+    ("l4.1x1b", 7, 512, 2048, 1, 1),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--shapes", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from incubator_mxnet_tpu.ops.pallas_conv import fused_conv_bn
+
+    n = args.batch
+    iters = args.iters
+    rs = np.random.RandomState(0)
+    only = set(args.shapes.split(",")) if args.shapes else None
+
+    def xla_chain(x, w, g, b):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+        k, s = w.shape[0], stride
+        y = lax.conv_general_dilated(
+            x, w, (s, s), [(k // 2, k // 2)] * 2, dimension_numbers=dn,
+            preferred_element_type=jnp.float32)
+        mu = jnp.mean(y, axis=(0, 1, 2))
+        var = jnp.maximum(jnp.mean(y * y, axis=(0, 1, 2)) - mu * mu, 0.0)
+        out = ((y - mu) * lax.rsqrt(var + 1e-5) * g + b)
+        return jnp.maximum(out, 0.0).astype(x.dtype)
+
+    def fused(x, w, a, b):
+        k = w.shape[0]
+        y, s_, ss = fused_conv_bn(x, w, a, b, stride=stride, pad=k // 2,
+                                  relu=True)
+        return y, s_, ss
+
+    print(f"batch={n} iters={iters} dev={jax.devices()[0].device_kind}")
+    print(f"{'shape':10s} {'conv ms':>8s} {'xla ms':>8s} {'fused ms':>9s} "
+          f"{'speedup':>8s} {'TF/s cv':>9s} {'TF/s fus':>9s}")
+    for name, h, ci, co, k, stride in SHAPES:
+        if only and name not in only:
+            continue
+        x = jnp.asarray(rs.randn(n, h, h, ci), jnp.bfloat16)
+        w = jnp.asarray(rs.randn(k, k, ci, co) * 0.05, jnp.bfloat16)
+        g = jnp.ones((co,), jnp.float32)
+        b = jnp.zeros((co,), jnp.float32)
+        a_pro = jnp.ones((ci,), jnp.float32)
+        b_pro = jnp.zeros((ci,), jnp.float32)
+        ho = h // stride
+        flops = 2 * n * ho * ho * ci * co * k * k
+
+        # serialize iterations through the (small) WEIGHT operand — a
+        # whole-x dependency multiply costs an extra HBM pass that
+        # pollutes the measurement; device_get moves ONE float (a full-
+        # tensor fetch through the axon tunnel costs seconds)
+        def _loop(step):
+            def run(x):
+                def body(_, wc):
+                    out = step(x, wc)
+                    # direct scalar index: reshape(-1)[0] forces a full
+                    # relayout pass and was masking the conv time
+                    dep = out[(0,) * out.ndim].astype(jnp.float32)
+                    return wc * (1.0 + 0.0 * dep).astype(wc.dtype)
+                return jnp.sum(lax.fori_loop(0, iters, body, w)[0, 0]
+                               ).astype(jnp.float32)
+            return run
+
+        def conv_only(x, wc):
+            dn = lax.conv_dimension_numbers(x.shape, wc.shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+            kk = wc.shape[0]
+            return lax.conv_general_dilated(
+                x, wc, (stride, stride), [(kk // 2, kk // 2)] * 2,
+                dimension_numbers=dn,
+                preferred_element_type=jnp.float32).astype(x.dtype)
+
+        loop_conv = _loop(conv_only)
+        loop_xla = _loop(lambda x, wc: xla_chain(x, wc, g, b))
+        loop_fused = _loop(lambda x, wc: fused(x, wc, a_pro, b_pro)[0])
+
+        res = {}
+        for label, fn in (("conv", loop_conv), ("xla", loop_xla),
+                          ("fused", loop_fused)):
+            try:
+                jf = jax.jit(fn)
+                float(jax.device_get(jf(x)))  # compile+warm
+                t0 = time.perf_counter()
+                float(jax.device_get(jf(x)))
+                dt = (time.perf_counter() - t0) / iters
+                res[label] = dt
+            except Exception as e:
+                print(f"{name:10s} {label} FAILED: {str(e)[:120]}")
+                res[label] = float("nan")
+        if all(np.isfinite(v) for v in res.values()):
+            print(f"{name:10s} {res['conv']*1e3:8.3f} {res['xla']*1e3:8.3f} "
+                  f"{res['fused']*1e3:9.3f} "
+                  f"{res['xla']/res['fused']:8.2f} "
+                  f"{flops/res['conv']/1e12:9.1f} "
+                  f"{flops/res['fused']/1e12:9.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

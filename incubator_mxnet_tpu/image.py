@@ -313,6 +313,36 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    # color augmentation wiring follows the reference CreateAugmenter:
+    # brightness/contrast/saturation jitters in random order, then hue,
+    # PCA lighting noise (fixed ImageNet eigen-decomposition), gray
+    color_augs: List[Augmenter] = []
+    if brightness > 0:
+        color_augs.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        color_augs.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        color_augs.append(SaturationJitterAug(saturation))
+    if color_augs:
+        auglist.append(RandomOrderAug(color_augs))
+    if hue > 0:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148], np.float32)
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]], np.float32)
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(
+            mean if mean is not None else np.zeros(3, np.float32),
+            std if std is not None else np.ones(3, np.float32)))
     return auglist
 
 

@@ -9,6 +9,7 @@ from . import linalg  # noqa: F401  (registers linalg family)
 from . import misc    # noqa: F401  (registers indexing/spatial/loss ops)
 from . import rnn_op  # noqa: F401  (registers fused RNN op)
 from . import pallas_attention  # noqa: F401  (registers flash_attention)
+from . import pallas_conv  # noqa: F401  (registers fused_conv_bn)
 from . import optimizer_ops  # noqa: F401  (registers update ops)
 from . import more  # noqa: F401  (registers samplers/image/misc ops)
 from . import moe   # noqa: F401  (registers mixture-of-experts ops)

@@ -73,8 +73,10 @@ def moe_gate_dispatch(logits, k=2, capacity_factor=1.25, capacity=0):
     dispatch = jnp.einsum("nke,nkc->nec", oh, pos_oh)
     combine = jnp.einsum("nke,nkc,nk->nec", oh, pos_oh, gate_vals)
 
-    # fraction of routed slots landing on each expert (post-capacity)
-    f = dispatch.sum((0, 2)) / max(N * k, 1)
+    # fraction of tokens ASSIGNED to each expert — pre-capacity, per the
+    # Switch/GShard definition: clamping f at C/(N*k) would attenuate the
+    # balancing gradient exactly when an expert overflows
+    f = oh.sum((0, 1)) / max(N * k, 1)
     P = probs.mean(0)
     aux_loss = E * jnp.sum(f * P)
     return dispatch, combine, aux_loss

@@ -14,10 +14,13 @@ the flash-attention recipe block-tiled for the MXU (q·kᵀ and p·v per
 causal mask uses the bottom-right alignment of the XLA reference
 (``tril(k=tk-tq)``) so decode-style tq != tk calls agree.
 
-Backward uses jax.vjp over the XLA reference path (recompute; no score
-matrix is saved between fwd and bwd). For the sequence lengths where the
-O(T²) bwd memory would matter, use parallel/ring_attention which owns its
-streaming backward.
+Backward (round 4) is a pair of streaming Pallas kernels — dQ over KV
+blocks, dK/dV over Q blocks — that recompute the probabilities per block
+from the saved log-sum-exp statistic, so no (T_q, T_k) score matrix is
+ever materialised in either direction: O(T) memory end to end, the
+FlashAttention-2 backward recipe. The same kernels serve as the per-
+rotation block engine of the differentiable Pallas ring
+(``parallel/ring_attention.ring_attention_pallas``).
 
 On non-TPU backends the same kernel runs through the Pallas interpreter
 (``interpret=True``) so correctness tests run on the CPU mesh.
@@ -192,6 +195,200 @@ def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512,
     return out.reshape(b, h, tqp, d)[:, :, :tq, :]
 
 
+def _flash_bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
+                         delta_ref, dq_ref, *, bq, bk, t_k, t_valid,
+                         tq_valid, scale, causal, n_heads):
+    """dQ = sum_j dS_j @ K_j, streaming KV blocks through VMEM.
+
+    P is recomputed per block from the saved row log-sum-exp (no score
+    matrix in HBM): p = exp(s - lse); ds = p * (dp - delta) * scale with
+    dp = g @ v^T and delta = rowsum(g * out) precomputed outside.
+    """
+    from jax import lax
+
+    pl = _pl()
+    qi = q_ref[0]                                 # (bq, d)
+    gi = g_ref[0]
+    lse = lse_ref[0].astype(jnp.float32)          # (bq,)
+    delta = delta_ref[0].astype(jnp.float32)
+    d = qi.shape[-1]
+    i = pl.program_id(1)
+    klen = len_ref[pl.program_id(0) // n_heads]
+    prec = (jax.lax.Precision.DEFAULT
+            if qi.dtype in (jnp.bfloat16, jnp.float16)
+            else jax.lax.Precision.HIGHEST)
+    diag_off = t_valid - tq_valid
+    rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    finite = jnp.isfinite(lse)[:, None]
+    lse_safe = jnp.where(finite, lse[:, None], 0.0)
+    delta_col = delta[:, None]
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * bk, bk), :]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        s = lax.dot_general(qi, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale
+        cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = cols < jnp.minimum(t_valid, klen)
+        if causal:
+            valid = valid & (cols <= rows + diag_off)
+        p = jnp.where(valid & finite, jnp.exp(s - lse_safe), 0.0)
+        dp = lax.dot_general(gi, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+        ds = p * (dp - delta_col) * scale
+        return acc + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        hi = lax.min((i + 1) * bq + diag_off + bk - 1, t_k) // bk
+        hi = lax.max(hi, 0)
+        acc = lax.fori_loop(0, hi, body, acc0)
+    else:
+        acc = lax.fori_loop(0, t_k // bk, body, acc0)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(len_ref, k_ref, v_ref, q_ref, g_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, *, bq, bk, t_q,
+                          t_valid, tq_valid, scale, causal, n_heads):
+    """dK = sum_i dS_i^T @ Q_i and dV = sum_i P_i^T @ dO_i, streaming Q
+    blocks for one resident KV block (grid dim 1 = KV block index)."""
+    from jax import lax
+
+    pl = _pl()
+    kj = k_ref[0]                                 # (bk, d)
+    vj = v_ref[0]
+    d = kj.shape[-1]
+    j = pl.program_id(1)
+    klen = len_ref[pl.program_id(0) // n_heads]
+    prec = (jax.lax.Precision.DEFAULT
+            if kj.dtype in (jnp.bfloat16, jnp.float16)
+            else jax.lax.Precision.HIGHEST)
+    diag_off = t_valid - tq_valid
+    cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid_col = cols < jnp.minimum(t_valid, klen)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :]
+        g = g_ref[0, pl.ds(i * bq, bq), :]
+        lse = lse_ref[0, pl.ds(i * bq, bq)].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(i * bq, bq)].astype(jnp.float32)
+        s = lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale
+        rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        valid = valid_col & (rows < tq_valid)     # mask padded q rows
+        if causal:
+            valid = valid & (cols <= rows + diag_off)
+        finite = jnp.isfinite(lse)[:, None]
+        p = jnp.where(valid & finite,
+                      jnp.exp(s - jnp.where(finite, lse[:, None], 0.0)),
+                      0.0)
+        dv = dv + lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dp = lax.dot_general(g, vj, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        return dk, dv
+
+    nq = t_q // bq
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    if causal:
+        # only q blocks containing rows >= col - diag_off can attend here
+        lo = lax.max(j * bk - diag_off, 0) // bq
+        lo = lax.min(lo, nq)
+        dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
+    else:
+        dk, dv = lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, lens, lse, delta, g, scale, causal, interpret,
+               bq=256, bk=256):
+    """Streaming flash backward: returns (dq, dk, dv) in the input dtypes.
+
+    ``lse``/``delta`` are (B, H, Tq) fp32 row statistics from the forward
+    (delta = rowsum(g * out)). Memory is O(T) — neither kernel ever holds
+    more than a (bq, bk) probability tile.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(bq, ((tq + 15) // 16) * 16)
+    bk = min(bk, ((tk + 15) // 16) * 16)
+    pad_q = (-tq) % bq
+    pad_k = (-tk) % bk
+    qf = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    gf = jnp.pad(g, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kf = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vf = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    # +inf pad => finite-mask kills padded q rows inside the kernels
+    lsef = jnp.pad(lse.astype(jnp.float32), ((0, 0), (0, 0), (0, pad_q)),
+                   constant_values=np.inf)
+    deltaf = jnp.pad(delta.astype(jnp.float32), ((0, 0), (0, 0),
+                                                 (0, pad_q)))
+    tqp, tkp = tq + pad_q, tk + pad_k
+    qf = qf.reshape(b * h, tqp, d)
+    gf = gf.reshape(b * h, tqp, d)
+    kf = kf.reshape(b * h, tkp, d)
+    vf = vf.reshape(b * h, tkp, d)
+    lsef = lsef.reshape(b * h, tqp)
+    deltaf = deltaf.reshape(b * h, tqp)
+    lens_arr = (jnp.full((b,), tk, jnp.int32) if lens is None
+                else lens.astype(jnp.int32))
+
+    common = dict(bq=bq, bk=bk, t_valid=tk, tq_valid=tq, scale=scale,
+                  causal=causal, n_heads=h)
+    len_spec = pl.BlockSpec((b,), lambda bi, i: (0,),
+                            memory_space=pltpu.SMEM)
+    q_blk = pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0))
+    q_full = pl.BlockSpec((1, tqp, d), lambda bi, i: (bi, 0, 0))
+    k_blk = pl.BlockSpec((1, bk, d), lambda bi, i: (bi, i, 0))
+    k_full = pl.BlockSpec((1, tkp, d), lambda bi, i: (bi, 0, 0))
+    row_blk = pl.BlockSpec((1, bq), lambda bi, i: (bi, i))
+    row_full = pl.BlockSpec((1, tqp), lambda bi, i: (bi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, t_k=tkp, **common),
+        grid=(b * h, tqp // bq),
+        in_specs=[len_spec, q_blk, k_full, k_full, q_blk, row_blk,
+                  row_blk],
+        out_specs=q_blk,
+        out_shape=jax.ShapeDtypeStruct((b * h, tqp, d), q.dtype),
+        interpret=interpret,
+    )(lens_arr, qf, kf, vf, gf, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, t_q=tqp, **common),
+        grid=(b * h, tkp // bk),
+        in_specs=[len_spec, k_blk, k_blk, q_full, q_full, row_full,
+                  row_full],
+        out_specs=[k_blk, k_blk],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tkp, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tkp, d), v.dtype)],
+        interpret=interpret,
+    )(lens_arr, kf, vf, qf, gf, lsef, deltaf)
+
+    dq = dq.reshape(b, h, tqp, d)[:, :, :tq, :]
+    dk = dk.reshape(b, h, tkp, d)[:, :, :tk, :]
+    dv = dv.reshape(b, h, tkp, d)[:, :, :tk, :]
+    return dq, dk, dv
+
+
 def _xla_reference(q, k, v, lengths, scale, causal):
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     tq, tk = scores.shape[-2], scores.shape[-1]
@@ -212,16 +409,17 @@ def _flash_core(q, k, v, lens, scale, causal, interpret):
 
 
 def _flash_core_fwd(q, k, v, lens, scale, causal, interpret):
-    return _flash_fwd(q, k, v, lens, scale, causal, interpret), (q, k, v,
-                                                                 lens)
+    out, lse = _flash_fwd(q, k, v, lens, scale, causal, interpret,
+                          return_lse=True)
+    return out, (q, k, v, lens, out, lse)
 
 
 def _flash_core_bwd(scale, causal, interpret, res, g):
-    q, k, v, lens = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: _xla_reference(a, b, c, lens, scale, causal),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, lens, out, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq, dk, dv = _flash_bwd(q, k, v, lens, lse, delta, g.astype(q.dtype),
+                            scale, causal, interpret)
     lens_ct = None if lens is None else \
         np.zeros(lens.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, lens_ct

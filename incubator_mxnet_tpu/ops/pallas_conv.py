@@ -1,0 +1,255 @@
+"""Pallas fused Conv2D + BatchNorm epilogue/prologue — the cuDNN
+``ConvolutionBiasActivationForward`` / BN-genstats analog for TPU.
+
+Why this exists (PROFILE.md, rounds 2-3): in ResNet training ~30% of the
+step is BatchNorm statistics passes that XLA cannot fuse into the adjacent
+convolutions — every BN re-reads the conv output from HBM to reduce
+per-channel mean/var, and the normalize-apply is another full read+write.
+The reference solves the same problem with cuDNN fused kernels
+(``src/operator/nn/cudnn/`` — SURVEY.md §2.1 operator-library row); the
+TPU-native solve is a Pallas conv kernel that
+
+* applies the PREVIOUS layer's BN (scale/shift) + ReLU to the input tile
+  while it sits in VMEM (prologue — the normalized activation is never
+  materialised in HBM), and
+* accumulates per-channel ``sum`` / ``sum-of-squares`` of its own raw
+  output while the tile is still in VMEM (stats epilogue — the separate
+  stat pass disappears).
+
+A chain of these kernels (a ResNet bottleneck) touches HBM once per conv
+in the forward instead of three times.
+
+Kernel shape contract (ResNet family): NHWC, square kernels 1x1/3x3
+(arbitrary odd sizes accepted), stride 1 or 2, symmetric padding, no
+groups/dilation. The 7x7 stem (C_in=3 wastes the MXU lane dim) and the
+residual join stay in XLA.
+
+Backward is ``jax.vjp`` over the XLA reference formulation (the raw conv
+output is linear in (x, w), so XLA DCEs the dead forward conv and keeps
+only the transpose convs + cheap prologue recompute); the BN-statistics
+cotangents (d_sum, d_sumsq from the next layer's coefficients) flow
+automatically.
+
+On non-TPU backends the kernel runs through the Pallas interpreter so the
+correctness suite covers it on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _prec(dtype):
+    return (lax.Precision.DEFAULT if dtype in (jnp.bfloat16, jnp.float16)
+            else lax.Precision.HIGHEST)
+
+
+def _fused_conv_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, ss_ref, *,
+                       stride, pad, relu, kh, kw, ho, wo, has_pro, nb,
+                       im2col):
+    """``nb`` batch images per grid program: prologue -> pad -> conv as
+    MXU matmuls (fp32 accumulation) -> stats epilogue.
+
+    Two matmul strategies: ``im2col`` gathers the kh*kw shifted views into
+    one (nb*ho*wo, kh*kw*ci) patch matrix in VMEM for a single deep-
+    contraction matmul (best when ci < 128 lanes); otherwise one matmul
+    per (ky, kx) tap."""
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...]                                 # (nb, H, W, Ci)
+    ci = x.shape[-1]
+    co = w_ref.shape[-1]
+    prec = _prec(x.dtype)
+    if has_pro:
+        xf = x.astype(jnp.float32) * a_ref[0][None, None, None, :] \
+            + b_ref[0][None, None, None, :]
+        if relu:
+            xf = jnp.maximum(xf, 0.0)
+        x = xf.astype(x_ref.dtype)
+    # extra (stride-1) bottom/right padding keeps the strided slice-
+    # reshape uniform for odd sizes; those rows are never selected
+    if pad or stride > 1:
+        x = jnp.pad(x, ((0, 0), (pad, pad + stride - 1),
+                        (pad, pad + stride - 1), (0, 0)))
+
+    def tap(ky, kx):
+        if stride == 1:
+            xs = x[:, ky:ky + ho, kx:kx + wo, :]
+        else:
+            s = stride
+            xs = x[:, ky:ky + s * ho, kx:kx + s * wo, :].reshape(
+                nb, ho, s, wo, s, ci)[:, :, 0, :, 0, :]
+        return xs.reshape(nb * ho * wo, ci)
+
+    if im2col and (kh, kw) != (1, 1):
+        patches = jnp.concatenate(
+            [tap(ky, kx) for ky in range(kh) for kx in range(kw)], axis=-1)
+        acc = lax.dot_general(
+            patches, w_ref[...].reshape(kh * kw * ci, co),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+    else:
+        acc = jnp.zeros((nb * ho * wo, co), jnp.float32)
+        for ky in range(kh):
+            for kx in range(kw):
+                acc = acc + lax.dot_general(
+                    tap(ky, kx), w_ref[ky, kx],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=prec)
+
+    y_ref[...] = acc.reshape(nb, ho, wo, co).astype(y_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        s_ref[...] = jnp.zeros_like(s_ref)
+        ss_ref[...] = jnp.zeros_like(ss_ref)
+
+    s_ref[0] += jnp.sum(acc, axis=0)
+    ss_ref[0] += jnp.sum(acc * acc, axis=0)
+
+
+def _out_size(h, pad, k, stride):
+    return (h + 2 * pad - k) // stride + 1
+
+
+def _fused_conv_ref(x, w, a, b, stride, pad, relu):
+    """XLA formulation with identical math (prologue in fp32, conv
+    accumulated in fp32, stats off the fp32 accumulator). Oracle for tests
+    and the linearization point for the backward pass."""
+    if a is not None:
+        xf = x.astype(jnp.float32) * a + b
+        if relu:
+            xf = jnp.maximum(xf, 0.0)
+        x = xf.astype(x.dtype)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    y32 = lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)], dimension_numbers=dn,
+        preferred_element_type=jnp.float32, precision=_prec(x.dtype))
+    s = jnp.sum(y32, axis=(0, 1, 2))
+    ss = jnp.sum(y32 * y32, axis=(0, 1, 2))
+    return y32.astype(x.dtype), s, ss
+
+
+def _pick_nb(n, ho, wo):
+    """Images per grid program: aim for ~1-2k matmul rows so the MXU's
+    M dimension is well fed even at 7x7 spatial sizes."""
+    target = 2048
+    nb = max(1, target // max(ho * wo, 1))
+    while n % nb:
+        nb -= 1
+    return nb
+
+
+def _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret):
+    from jax.experimental import pallas as pl
+
+    n, h, wdt, ci = x.shape
+    kh, kw, wci, co = w.shape
+    assert wci == ci, f"channel mismatch {wci} != {ci}"
+    ho = _out_size(h, pad, kh, stride)
+    wo = _out_size(wdt, pad, kw, stride)
+    has_pro = a is not None
+    if not has_pro:  # dummy operands keep one kernel signature
+        a = jnp.ones((ci,), jnp.float32)
+        b = jnp.zeros((ci,), jnp.float32)
+    nb = _pick_nb(n, ho, wo)
+    # deep-contraction im2col pays off when the per-tap contraction is
+    # shallower than the MXU's 128 lanes
+    im2col = ci < 128 and (kh, kw) != (1, 1)
+
+    kernel = functools.partial(
+        _fused_conv_kernel, stride=stride, pad=pad, relu=relu, kh=kh,
+        kw=kw, ho=ho, wo=wo, has_pro=has_pro, nb=nb, im2col=im2col)
+    y, s, ss = pl.pallas_call(
+        kernel,
+        grid=(n // nb,),
+        in_specs=[
+            pl.BlockSpec((nb, h, wdt, ci), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((kh, kw, ci, co), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, ci), lambda i: (0, 0)),
+            pl.BlockSpec((1, ci), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, ho, wo, co), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, co), lambda i: (0, 0)),
+            pl.BlockSpec((1, co), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, ho, wo, co), x.dtype),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w, a.astype(jnp.float32).reshape(1, ci),
+      b.astype(jnp.float32).reshape(1, ci))
+    return y, s[0], ss[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_conv(x, w, a, b, stride, pad, relu, interpret):
+    return _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
+
+
+def _fused_conv_fwd(x, w, a, b, stride, pad, relu, interpret):
+    out = _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
+    return out, (x, w, a, b)
+
+
+def _fused_conv_bwd(stride, pad, relu, interpret, res, cts):
+    x, w, a, b = res
+    if a is None:
+        _, vjp = jax.vjp(
+            lambda x_, w_: _fused_conv_ref(x_, w_, None, None, stride, pad,
+                                           relu), x, w)
+        dx, dw = vjp(cts)
+        return dx, dw, None, None
+    _, vjp = jax.vjp(
+        lambda x_, w_, a_, b_: _fused_conv_ref(x_, w_, a_, b_, stride, pad,
+                                               relu), x, w, a, b)
+    return vjp(cts)
+
+
+_fused_conv.defvjp(_fused_conv_fwd, _fused_conv_bwd)
+
+
+from .pallas_attention import pallas_available as pallas_conv_available
+
+
+@register("fused_conv_bn")
+def fused_conv_bn(x, w, a=None, b=None, stride=1, pad=0, relu=True,
+                  interpret=None):
+    """Fused (prologue-BN+ReLU) -> Conv2D -> (stats epilogue).
+
+    x: (N, H, W, Ci) NHWC; w: (kh, kw, Ci, Co) HWIO; a/b: optional (Ci,)
+    fp32 scale/shift applied to x first (the PREVIOUS BatchNorm folded to
+    ``a = gamma/sqrt(var+eps)``, ``b = beta - mean*a``); ``relu`` gates the
+    prologue activation. Returns ``(y_raw, sum, sumsq)`` where the fp32
+    per-channel stats are taken over the raw conv output — feed them to
+    :func:`bn_scale_shift` to fold THIS layer's BN into the next call.
+    """
+    if interpret is None:
+        interpret = not pallas_conv_available()
+    return _fused_conv(x, w, a, b, int(stride), int(pad), bool(relu),
+                       bool(interpret))
+
+
+def bn_scale_shift(s, ss, count, gamma, beta, eps=1e-5):
+    """Fold batch statistics + BN parameters into per-channel (a, b) for
+    the next kernel's prologue. Returns (a, b, mean, var) — mean/var for
+    the running-stat update (gluon BatchNorm semantics)."""
+    count = jnp.asarray(count, jnp.float32)
+    mean = s / count
+    var = jnp.maximum(ss / count - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    a = gamma.astype(jnp.float32) * inv
+    b = beta.astype(jnp.float32) - mean * a
+    return a, b, mean, var

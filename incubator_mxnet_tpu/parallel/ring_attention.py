@@ -105,26 +105,10 @@ def _merge_partials(o1, lse1, o2, lse2):
     return o, lse
 
 
-def ring_attention_pallas(q, k, v, axis_name: str = SEQ_AXIS,
-                          causal: bool = False,
-                          interpret: Optional[bool] = None):
-    """Ring attention with the Pallas flash kernel as the per-shard block
-    engine (SURVEY §2.4 CP row: "Pallas ring-attention / blockwise
-    attention over ICI ring").
-
-    Each rotation runs the compiled flash kernel over (q_local, kv_blk)
-    emitting (out, lse); partials merge flash-decoding style. The ring is
-    a static python loop (n is the mesh-axis size), so the diagonal
-    rotation uses the kernel's causal path and off-diagonal visibility is
-    a traced whole-block weight.
-
-    Forward-optimized (inference / frozen-attention); the jnp ring path
-    stays the differentiable one.
-    """
+def _ring_pallas_fwd_impl(q, k, v, axis_name, causal, interpret):
+    """Forward rotation loop; returns (o_f32, global lse)."""
     from ..ops.pallas_attention import _flash_fwd
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
@@ -148,15 +132,97 @@ def ring_attention_pallas(q, k, v, axis_name: str = SEQ_AXIS,
         if i + 1 < n:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
-    return o_acc.astype(q.dtype)
+    return o_acc, lse_acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_pallas(q, k, v, axis_name, causal, interpret):
+    o, _ = _ring_pallas_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return o.astype(q.dtype)
+
+
+def _ring_pallas_vjp_fwd(q, k, v, axis_name, causal, interpret):
+    o, lse = _ring_pallas_fwd_impl(q, k, v, axis_name, causal, interpret)
+    o = o.astype(q.dtype)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_pallas_vjp_bwd(axis_name, causal, interpret, res, g):
+    """Ring flash backward: KV blocks rotate exactly as in the forward and
+    the dK/dV accumulators travel WITH their blocks — after the full n
+    rotations each accumulator is back on the shard that owns the block
+    (Ring Attention, Liu et al. 2023, backward pass). The per-rotation
+    engine is the streaming Pallas backward (`_flash_bwd`), fed the GLOBAL
+    log-sum-exp, so per-block probabilities are already the global-softmax
+    rows and contributions simply sum. O(T/n) memory per device."""
+    from ..ops.pallas_attention import _flash_bwd
+
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    g = g.astype(q.dtype)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_rot = jnp.zeros(k.shape, jnp.float32)
+    dv_rot = jnp.zeros(v.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for i in range(n):
+        dq_i, dk_i, dv_i = _flash_bwd(
+            q, k_blk, v_blk, None, lse, delta, g, scale,
+            causal and i == 0, interpret)
+        if causal and i > 0:
+            visible = my >= i
+            dq_i = jnp.where(visible, dq_i, 0)
+            dk_i = jnp.where(visible, dk_i, 0)
+            dv_i = jnp.where(visible, dv_i, 0)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_rot = dk_rot + dk_i.astype(jnp.float32)
+        dv_rot = dv_rot + dv_i.astype(jnp.float32)
+        # rotate every iteration (n total) so dk/dv land back home
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_rot = lax.ppermute(dk_rot, axis_name, perm)
+        dv_rot = lax.ppermute(dv_rot, axis_name, perm)
+    return (dq_acc.astype(q.dtype), dk_rot.astype(k.dtype),
+            dv_rot.astype(v.dtype))
+
+
+_ring_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
+
+
+def ring_attention_pallas(q, k, v, axis_name: str = SEQ_AXIS,
+                          causal: bool = False,
+                          interpret: Optional[bool] = None):
+    """Ring attention with the Pallas flash kernels as the per-shard block
+    engine (SURVEY §2.4 CP row: "Pallas ring-attention / blockwise
+    attention over ICI ring").
+
+    Forward: each rotation runs the compiled flash kernel over (q_local,
+    kv_blk) emitting (out, lse); partials merge flash-decoding style. The
+    ring is a static python loop (n is the mesh-axis size), so the
+    diagonal rotation uses the kernel's causal path and off-diagonal
+    visibility is a traced whole-block weight.
+
+    Backward (round 4): differentiable — a custom vjp re-rotates KV around
+    the ring, running the streaming Pallas flash backward per rotation
+    with the saved global lse; dK/dV accumulators ride the ring home.
+    Memory stays O(T/n) per device in both directions.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _ring_pallas(q, k, v, axis_name, bool(causal), bool(interpret))
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
                            causal: bool = False, impl: str = "xla"):
     """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; T is sharded
     over ``axis_name`` of ``mesh``. ``impl='pallas'`` runs the flash
-    kernel per ring block (forward-optimized); ``'xla'`` is the
-    differentiable streaming-softmax path."""
+    kernels per ring block (differentiable: streaming Pallas backward);
+    ``'xla'`` is the jnp streaming-softmax path. Both support
+    ``jax.grad``."""
     from jax import shard_map
 
     spec = P(None, None, axis_name, None)

@@ -136,8 +136,10 @@ class SPMDTrainer:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS,
-                 donate: bool = True,
+                 *, donate: bool = True,
                  shard_weight_update: bool = False):
+        # donate/shard_weight_update are keyword-only: a removed middle
+        # parameter must fail loudly on stale positional call sites
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else make_mesh()

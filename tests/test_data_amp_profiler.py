@@ -368,3 +368,35 @@ def test_image_jitter_augmenters():
     comp = image.SequentialAug([image.BrightnessJitterAug(0.1),
                                 image.CastAug()])
     assert comp(img).shape == img.shape
+
+
+def test_create_augmenter_wires_color_args():
+    """ADVICE r3: CreateAugmenter must honor brightness/contrast/
+    saturation/hue/pca_noise/rand_gray/mean/std instead of silently
+    dropping them (reference CreateAugmenter behavior)."""
+    from incubator_mxnet_tpu import image
+
+    augs = image.CreateAugmenter((3, 16, 16), brightness=0.2, contrast=0.2,
+                                 saturation=0.2, hue=0.1, pca_noise=0.05,
+                                 rand_gray=0.3, mean=True, std=True)
+    kinds = [type(a).__name__ for a in augs]
+    assert "RandomOrderAug" in kinds
+    assert "HueJitterAug" in kinds
+    assert "LightingAug" in kinds
+    assert "RandomGrayAug" in kinds
+    assert "ColorNormalizeAug" in kinds
+    order_aug = augs[kinds.index("RandomOrderAug")]
+    inner = {type(a).__name__ for a in order_aug.ts}
+    assert inner == {"BrightnessJitterAug", "ContrastJitterAug",
+                     "SaturationJitterAug"}
+    # default: no color args -> no color augs (unchanged behavior)
+    plain = [type(a).__name__
+             for a in image.CreateAugmenter((3, 16, 16))]
+    assert "RandomOrderAug" not in plain
+    assert "ColorNormalizeAug" not in plain
+    # the pipeline actually runs
+    rs2 = np.random.RandomState(1)
+    img = mx.nd.array(rs2.rand(20, 20, 3).astype(np.float32) * 255)
+    for a in augs:
+        img = a(img)
+    assert np.isfinite(img.asnumpy()).all()

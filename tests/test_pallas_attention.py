@@ -11,6 +11,17 @@ from incubator_mxnet_tpu import ndarray as nd
 from incubator_mxnet_tpu.models import MultiHeadAttention
 
 
+def _grad_tols():
+    """f32 gradient tolerances: tight under the CPU interpreter; looser on
+    the chip, where kernel and XLA reference take different MXU passes
+    (observed max rel diff ~6e-3 on compiled f32 matmuls)."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return dict(rtol=2e-2, atol=5e-4)
+    return dict(rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.parametrize("shape,causal", [
     ((2, 3, 64, 32), False),
     ((1, 2, 100, 16), True),     # non-multiple-of-block T exercises padding
@@ -135,6 +146,154 @@ def test_ring_attention_pallas_matches_xla_ring():
             q, k, v, mesh, causal=causal, impl="pallas"))
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5,
                                    err_msg=f"causal={causal}")
+
+
+@pytest.mark.parametrize("shape,causal", [
+    ((2, 2, 64, 32), False),
+    ((1, 2, 100, 16), True),     # non-multiple-of-block T: padded rows
+    ((2, 1, 256, 64), True),
+])
+def test_flash_bwd_full_grads_match_xla(shape, causal):
+    """dq, dk AND dv from the streaming Pallas backward vs jax.grad of the
+    XLA reference (round 4: the backward is a Pallas kernel pair, not an
+    XLA recompute)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (
+        _flash_core, _xla_reference, pallas_available)
+
+    interp = not pallas_available()   # compiled kernel on the chip tier
+
+    rng = np.random.RandomState(7)
+    b, h, t, d = shape
+    q = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    s = 1.0 / float(np.sqrt(d))
+
+    def loss_flash(q, k, v):
+        o = _flash_core(q, k, v, None, s, causal, interp)
+        return jnp.sum(jnp.sin(o))          # non-uniform cotangent
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_xla_reference(q, k, v, None, s, causal)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   err_msg=f"d{name}", **_grad_tols())
+
+
+def test_flash_bwd_lengths_grads_match_xla():
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (
+        _flash_core, _xla_reference, pallas_available)
+
+    interp = not pallas_available()   # compiled kernel on the chip tier
+
+    rng = np.random.RandomState(8)
+    b, h, t, d = 3, 2, 48, 16
+    q = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    lens = jnp.asarray(np.array([48, 17, 5], np.int32))
+    s = 1.0 / float(np.sqrt(d))
+
+    gf = jax.grad(lambda *a: jnp.sum(jnp.cos(
+        _flash_core(*a, lens, s, False, interp))), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(jnp.cos(
+        _xla_reference(*a, lens, s, False))), argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   err_msg=f"d{name}", **_grad_tols())
+
+
+def test_flash_bwd_cross_attention_grads():
+    # tq != tk with bottom-right causal alignment in BOTH kernels
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (
+        _flash_core, _xla_reference, pallas_available)
+
+    interp = not pallas_available()   # compiled kernel on the chip tier
+
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 2, 20, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 2, 52, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 2, 52, 16).astype(np.float32))
+    s = 0.25
+
+    gf = jax.grad(lambda *a: jnp.sum(jnp.sin(
+        _flash_core(*a, None, s, True, interp))), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(jnp.sin(
+        _xla_reference(*a, None, s, True))), argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   err_msg=f"d{name}", **_grad_tols())
+
+
+def test_flash_bwd_bf16():
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (
+        _flash_core, _xla_reference, pallas_available)
+
+    interp = not pallas_available()   # compiled kernel on the chip tier
+
+    rng = np.random.RandomState(10)
+    q = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    s = 1.0 / float(np.sqrt(32))
+
+    gf = jax.grad(lambda *a: jnp.sum(
+        _flash_core(*a, None, s, True, interp).astype(jnp.float32)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _xla_reference(*a, None, s, True).astype(jnp.float32)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            rtol=0.08, atol=0.08, err_msg=f"d{name}")
+
+
+def test_ring_pallas_grads_match_xla_ring():
+    """SURVEY §2.4 CP row: ring_attention_sharded(impl='pallas') must be
+    usable under jax.grad — the round-3 gap (forward-only Pallas ring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.ring_attention import (
+        ring_attention_sharded)
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = parallel.make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    rs = np.random.RandomState(11)
+    B, H, T, D = 2, 2, 64, 16
+    q = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(rs.rand(B, H, T, D).astype(np.float32))
+
+    for causal in (False, True):
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(jnp.sin(ring_attention_sharded(
+                q, k, v, mesh, causal=causal, impl=impl)))
+
+        gp = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+        for a, b_, name in zip(gp, gx, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), err_msg=f"causal={causal} d{name}", **_grad_tols())
 
 
 def test_ulysses_pallas_matches_xla():

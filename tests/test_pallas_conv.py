@@ -1,0 +1,185 @@
+"""Fused Pallas conv+BN kernel tests (interpret mode on the CPU mesh; the
+same code path compiles for the TPU tier — see TPU_TESTS.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu.ops.pallas_conv import (_fused_conv_ref,
+                                                 bn_scale_shift,
+                                                 fused_conv_bn)
+
+
+def _rand(rs, shape, dtype=np.float32):
+    return jnp.asarray(rs.randn(*shape).astype(np.float32), dtype)
+
+
+@pytest.mark.parametrize("cfg", [
+    # (H, Ci, Co, k, stride, pad) — the ResNet-50 conv shape family, tiny
+    dict(h=8, ci=16, co=32, k=1, stride=1, pad=0),
+    dict(h=8, ci=16, co=16, k=3, stride=1, pad=1),
+    dict(h=9, ci=8, co=16, k=3, stride=2, pad=1),     # odd H downsample
+    dict(h=8, ci=16, co=32, k=1, stride=2, pad=0),    # 1x1 downsample
+    dict(h=7, ci=8, co=8, k=3, stride=1, pad=1),
+])
+def test_fused_conv_matches_xla(cfg):
+    rs = np.random.RandomState(0)
+    n = 2
+    x = _rand(rs, (n, cfg["h"], cfg["h"], cfg["ci"]))
+    w = _rand(rs, (cfg["k"], cfg["k"], cfg["ci"], cfg["co"])) * 0.1
+    y, s, ss = fused_conv_bn(x, w, stride=cfg["stride"], pad=cfg["pad"])
+    yr, sr, ssr = _fused_conv_ref(x, w, None, None, cfg["stride"],
+                                  cfg["pad"], True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_conv_prologue_matches_xla():
+    rs = np.random.RandomState(1)
+    x = _rand(rs, (2, 8, 8, 16))
+    w = _rand(rs, (3, 3, 16, 32)) * 0.1
+    a = jnp.asarray(rs.rand(16).astype(np.float32) + 0.5)
+    b = _rand(rs, (16,))
+    for relu in (True, False):
+        y, s, ss = fused_conv_bn(x, w, a, b, stride=1, pad=1, relu=relu)
+        yr, sr, ssr = _fused_conv_ref(x, w, a, b, 1, 1, relu)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"relu={relu}")
+        np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_conv_stats_equal_batchnorm_stats():
+    """The epilogue stats must reproduce exactly what a separate BatchNorm
+    stat pass would compute over the conv output."""
+    rs = np.random.RandomState(2)
+    x = _rand(rs, (3, 8, 8, 8))
+    w = _rand(rs, (3, 3, 8, 16)) * 0.1
+    y, s, ss = fused_conv_bn(x, w, stride=1, pad=1)
+    count = y.shape[0] * y.shape[1] * y.shape[2]
+    gamma = jnp.asarray(rs.rand(16).astype(np.float32) + 0.5)
+    beta = _rand(rs, (16,))
+    a, b, mean, var = bn_scale_shift(s, ss, count, gamma, beta, eps=1e-5)
+    y32 = np.asarray(y, np.float32)
+    np.testing.assert_allclose(np.asarray(mean),
+                               y32.mean(axis=(0, 1, 2)), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(var), y32.var(axis=(0, 1, 2)),
+                               rtol=2e-3, atol=2e-3)
+    # normalize via (a, b) == classic batchnorm
+    got = y32 * np.asarray(a) + np.asarray(b)
+    ref = (y32 - y32.mean((0, 1, 2))) / np.sqrt(
+        y32.var((0, 1, 2)) + 1e-5) * np.asarray(gamma) + np.asarray(beta)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_conv_grads_match_xla():
+    """dx, dw, da, db — including the stats cotangents (the next layer's
+    BN coefficients depend on this layer's sum/sumsq)."""
+    rs = np.random.RandomState(3)
+    x = _rand(rs, (2, 6, 6, 8))
+    w = _rand(rs, (3, 3, 8, 8)) * 0.2
+    a = jnp.asarray(rs.rand(8).astype(np.float32) + 0.5)
+    b = _rand(rs, (8,))
+
+    # gentle nonlinearities: s/ss are O(10^2) channel sums, so cos(s)
+    # would turn a ~1e-5 fused-vs-ref forward delta into a large
+    # cotangent swing that tests float noise, not the vjp wiring
+    def loss_fused(x, w, a, b):
+        y, s, ss = fused_conv_bn(x, w, a, b, stride=1, pad=1)
+        return (jnp.sum(jnp.sin(y.astype(jnp.float32)))
+                + jnp.sum(jnp.cos(s * 1e-2))
+                + jnp.sum(jnp.tanh(ss * 1e-3)))
+
+    def loss_ref(x, w, a, b):
+        y, s, ss = _fused_conv_ref(x, w, a, b, 1, 1, True)
+        return (jnp.sum(jnp.sin(y.astype(jnp.float32)))
+                + jnp.sum(jnp.cos(s * 1e-2))
+                + jnp.sum(jnp.tanh(ss * 1e-3)))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, a, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, a, b)
+    for got, ref, name in zip(gf, gr, ("dx", "dw", "da", "db")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_fused_conv_grads_no_prologue():
+    rs = np.random.RandomState(4)
+    x = _rand(rs, (2, 6, 6, 8))
+    w = _rand(rs, (1, 1, 8, 16)) * 0.2
+
+    def loss(fn):
+        def f(x, w):
+            y, s, ss = fn(x, w)
+            return jnp.sum(jnp.sin(y)) + jnp.sum(s) * 0.1 + jnp.sum(
+                jnp.sqrt(ss + 1.0))
+        return f
+
+    gf = jax.grad(loss(lambda x, w: fused_conv_bn(x, w, stride=2, pad=0)),
+                  argnums=(0, 1))(x, w)
+    gr = jax.grad(
+        loss(lambda x, w: _fused_conv_ref(x, w, None, None, 2, 0, True)),
+        argnums=(0, 1))(x, w)
+    for got, ref, name in zip(gf, gr, ("dx", "dw")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_fused_conv_bf16():
+    rs = np.random.RandomState(5)
+    x = _rand(rs, (2, 8, 8, 16), jnp.bfloat16)
+    w = _rand(rs, (3, 3, 16, 16), jnp.bfloat16) * 0.1
+    y, s, ss = fused_conv_bn(x, w, stride=1, pad=1)
+    yr, sr, ssr = _fused_conv_ref(x, w, None, None, 1, 1, True)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=0.03, atol=0.5)
+
+
+def test_bottleneck_chain_matches_unfused():
+    """A ResNet bottleneck forward (1x1 -> 3x3 -> 1x1 with BN between)
+    through the fused kernels == the classic conv/batchnorm chain."""
+    rs = np.random.RandomState(6)
+    n, h, c = 2, 8, 16
+    x = _rand(rs, (n, h, h, c))
+    w1 = _rand(rs, (1, 1, c, 8)) * 0.3
+    w2 = _rand(rs, (3, 3, 8, 8)) * 0.3
+    g1, b1 = jnp.ones((8,)), jnp.zeros((8,))
+    g2, b2 = (jnp.asarray(rs.rand(8).astype(np.float32) + 0.5),
+              _rand(rs, (8,)))
+
+    y1, s1, ss1 = fused_conv_bn(x, w1, stride=1, pad=0)
+    a1, sh1, m1, v1 = bn_scale_shift(s1, ss1, n * h * h, g1, b1)
+    y2, s2, ss2 = fused_conv_bn(y1, w2, a1, sh1, stride=1, pad=1,
+                                relu=True)
+    a2, sh2, m2, v2 = bn_scale_shift(s2, ss2, n * h * h, g2, b2)
+    out = np.asarray(y2, np.float32) * np.asarray(a2) + np.asarray(sh2)
+
+    # unfused oracle
+    def conv(x, w, pad):
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(pad, pad), (pad, pad)], dimension_numbers=dn,
+            precision=jax.lax.Precision.HIGHEST)
+
+    def bn(y, g, b):
+        mu = y.mean((0, 1, 2))
+        var = y.var((0, 1, 2))
+        return (y - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+    r1 = jax.nn.relu(bn(conv(x, w1, 0), g1, b1))
+    ref = bn(conv(r1, w2, 1), g2, b2)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-3, atol=2e-3)
