@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Whole-model decision benchmark: fused-Pallas ResNet-50 vs the unfused
+zoo ResNet-50, full SPMD train step (fwd+bwd+SGD momentum, bf16),
+back-to-back in ONE process (between-process tunnel variance is +/-20-30%,
+PROFILE.md — only within-process ordering is meaningful).
+
+Usage: python benchmark/fused_resnet_bench.py [--batch 128] [--iters 10]
+       [--variants fused,zoo]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_trainer(variant, batch):
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    if variant == "fused":
+        net = vision.fused_resnet50_v1(classes=1000)
+    else:
+        net = vision.resnet50_v1(classes=1000)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, 3, 224, 224), dtype="bfloat16"))
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec("data"))
+    rs = np.random.RandomState(0)
+    x = jax.device_put(
+        jnp.asarray(rs.rand(batch, 3, 224, 224), jnp.bfloat16), sh)
+    y = jax.device_put(
+        jnp.asarray(rs.randint(0, 1000, (batch,)), jnp.float32), sh)
+    return trainer, (x, y)
+
+
+def timed(trainer, args, iters):
+    import jax
+
+    loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    for _ in range(2):
+        loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(*args)
+    float(jax.device_get(loss))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--variants", type=str, default="zoo,fused,zoo,fused")
+    args = ap.parse_args()
+
+    import gc
+
+    for variant in args.variants.split(","):
+        try:
+            trainer, data = build_trainer(variant, args.batch)
+            dt = timed(trainer, data, args.iters)
+            print(f"{variant:6s} {dt * 1e3:8.2f} ms/step "
+                  f"{args.batch / dt:9.1f} img/s", flush=True)
+            del trainer, data
+        except Exception as e:
+            print(f"{variant:6s} FAILED: {str(e)[:400]}", flush=True)
+        gc.collect()
+
+
+if __name__ == "__main__":
+    main()

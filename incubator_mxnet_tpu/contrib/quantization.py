@@ -2,12 +2,18 @@
 ``python/mxnet/contrib/quantization.py`` quantize_model).
 
 Scope (inference): per-channel symmetric int8 weights for Dense/Conv
-layers + per-tensor activation calibration (minmax or entropy-free
-percentile), with the matmul running int8 x int8 -> int32 on the MXU
-(``preferred_element_type=int32`` — the TPU analog of cuDNN/oneDNN int8
-kernels) and dequantize fused into the epilogue.
+layers + per-tensor activation calibration — ``calib_mode='minmax'`` or
+``'entropy'`` (KL-divergence threshold search over an 8001-bin histogram,
+the reference ``_get_optimal_threshold`` recipe) — with the matmul
+running int8 x int8 -> int32 on the MXU (``preferred_element_type=int32``
+— the TPU analog of cuDNN/oneDNN int8 kernels) and dequantize fused into
+the epilogue. Pooling and concat also run int8 (``quantized_pooling``,
+``quantized_concat``), so an int8 ResNet block round-trips through float
+only at its boundary; under jit the boundary dequantize->quantize pairs
+fuse into requantizes on int8 data.
 
-    qnet = quantize_model(net, calib_data=[x1, x2, ...])
+    qnet = quantize_model(net, calib_data=[x1, x2, ...],
+                          calib_mode="entropy")
     out = qnet(x)
 """
 
@@ -164,39 +170,235 @@ class QuantizedConv2D(HybridBlock):
                       differentiable=False)
 
 
+@register("quantized_pooling", differentiable=False)
+def quantized_pooling(x_q, scale=None, pool_type="max", kernel=(2, 2),
+                      stride=None, pad=(0, 0), count_include_pad=True):
+    """Pooling directly on int8 data (reference quantized_pooling): max
+    pool is order-preserving so it runs on the int8 values; avg pool
+    accumulates int32 and rounds back to int8 with the SAME scale. NCHW.
+    ``count_include_pad`` matches the float Pooling op (gluon AvgPool2D
+    default True: divide by the full kernel size at borders)."""
+    kh, kw = kernel
+    stride = stride or kernel
+    window = (1, 1, kh, kw)
+    strides = (1, 1, stride[0], stride[1])
+    pads = [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])]
+    if pool_type == "max":
+        out = jax.lax.reduce_window(
+            x_q, jnp.asarray(-128, x_q.dtype), jax.lax.max, window,
+            strides, pads)
+    elif pool_type == "avg":
+        acc = jax.lax.reduce_window(
+            x_q.astype(jnp.int32), jnp.asarray(0, jnp.int32), jax.lax.add,
+            window, strides, pads)
+        if count_include_pad:
+            cnt = kh * kw
+        else:
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(x_q, jnp.int32), jnp.asarray(0, jnp.int32),
+                jax.lax.add, window, strides, pads)
+        out = jnp.clip(jnp.round(acc / cnt), -127, 127).astype(x_q.dtype)
+    else:
+        raise ValueError(f"pool_type {pool_type!r}")
+    return out, scale
+
+
+@register("quantized_concat", differentiable=False)
+def quantized_concat(*args, dim=1):
+    """Concat int8 tensors with per-tensor scales (reference
+    quantized_concat): requantize every input to the LARGEST scale so the
+    output shares one scale."""
+    n = len(args) // 2
+    qs, scales = args[:n], args[n:]
+    out_scale = scales[0]
+    for s in scales[1:]:
+        out_scale = jnp.maximum(out_scale, s)
+    parts = [jnp.clip(jnp.round(q.astype(jnp.float32) * (s / out_scale)),
+                      -127, 127).astype(qs[0].dtype)
+             for q, s in zip(qs, scales)]
+    return jnp.concatenate(parts, axis=dim), out_scale
+
+
+class QuantizedPooling(HybridBlock):
+    """Int8 pooling with the calibrated input range (the float boundary
+    quantize/dequantize fuses into neighbouring int8 ops under jit)."""
+
+    def __init__(self, pool, a_min: float, a_max: float, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._absmax = float(max(abs(a_min), abs(a_max), 1e-8))
+        self._kind = pool._type            # "max" | "avg" (_Pool attr)
+        self._kernel = tuple(pool._kernel)
+        self._stride = tuple(pool._strides)
+        self._pad = tuple(pool._padding)
+        self._count_include_pad = bool(
+            getattr(pool, "_count_include_pad", True))
+        if getattr(pool, "_ceil", False):
+            # 'full' pooling convention changes the output SHAPE; the
+            # int8 kernel only implements 'valid' — refuse loudly rather
+            # than silently mis-shaping the graph
+            raise NotImplementedError(
+                "quantized pooling does not support ceil_mode=True; "
+                "exclude this block from quantize_pooling")
+
+    def forward(self, x, *args):
+        a_scale = self._absmax / 127.0
+        kind, kernel = self._kind, self._kernel
+        stride, pad = self._stride, self._pad
+        cip = self._count_include_pad
+
+        def fn(xd):
+            xq = jnp.clip(jnp.round(xd / a_scale), -127, 127
+                          ).astype(jnp.int8)
+            out, _ = quantized_pooling(xq, scale=jnp.float32(a_scale),
+                                       pool_type=kind, kernel=kernel,
+                                       stride=stride, pad=pad,
+                                       count_include_pad=cip)
+            return out.astype(jnp.float32) * a_scale
+
+        return invoke(fn, [x], name="quantized_pooling",
+                      differentiable=False)
+
+
+def _optimal_threshold_kl(hist: np.ndarray, edges: np.ndarray,
+                          num_quantized_bins: int = 255) -> float:
+    """KL-divergence threshold search (reference calibrate.py
+    ``_get_optimal_threshold`` / the TensorRT entropy-calibration recipe).
+
+    ``hist`` is a symmetric histogram over [-absmax, absmax]. For each
+    candidate threshold, outliers are clipped into the edge bins, the
+    clipped distribution P is quantized to ``num_quantized_bins`` levels,
+    expanded back to Q over P's support, and KL(P||Q) is scored; the
+    threshold with minimal divergence wins.
+    """
+    num_bins = len(hist)
+    zero = num_bins // 2
+    best_kl, best_th = np.inf, float(edges[-1])
+    bin_width = edges[1] - edges[0]
+    half_quant = num_quantized_bins // 2
+    eps = 1e-4  # _smooth_distribution analog
+
+    for i in range(half_quant + 1, zero + 1):
+        start, stop = zero - i, zero + i + 1
+        sliced = hist[start:stop].astype(np.float64)
+        # P: clipped outlier mass folded into the edge bins. Q: built from
+        # the UNFOLDED slice — this asymmetry is what penalises severe
+        # clipping (with Q built from P, a threshold narrow enough that
+        # len(P) ~ num_quantized_bins would quantize losslessly and win
+        # with KL=0 regardless of how much mass it clipped).
+        p = sliced.copy()
+        p[0] += hist[:start].sum()
+        p[-1] += hist[stop:].sum()
+        if p.sum() == 0:
+            continue
+        nonzero = sliced != 0
+        n = len(sliced)
+        factor = n / num_quantized_bins
+        q = np.zeros(n, np.float64)
+        for j in range(num_quantized_bins):
+            lo = int(np.floor(j * factor))
+            hi = min(int(np.ceil((j + 1) * factor)), n)
+            seg_nz = nonzero[lo:hi]
+            cnt = seg_nz.sum()
+            if cnt:
+                q[lo:hi][seg_nz] = sliced[lo:hi][seg_nz].sum() / cnt
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qn = q / qs
+        # eps-smooth both so log stays finite (reference
+        # _smooth_distribution)
+        pn = np.where(pn > 0, pn, eps / n)
+        qn = np.where(qn > 0, qn, eps / n)
+        pn /= pn.sum()
+        qn /= qn.sum()
+        kl = float(np.sum(pn * np.log(pn / qn)))
+        if kl < best_kl:
+            best_kl = kl
+            best_th = (i + 0.5) * bin_width
+    return best_th
+
+
 class _CalibCollector:
+    """Min/max + (optionally) histogram collection per calibrated block.
+
+    ``entropy`` mode needs two passes: pass 1 finds the absolute range,
+    pass 2 fills an ``num_bins`` histogram over it (the reference
+    _LayerHistogramCollector re-bins incrementally; two passes over the
+    in-memory calib list are equivalent and simpler).
+    """
+
+    NUM_BINS = 8001
+
     def __init__(self):
         self.ranges: Dict[int, List[float]] = {}
+        self.hists: Dict[int, np.ndarray] = {}
+        self.collect_hist = False
 
     def hook(self, block, inputs):
         x = inputs[0]
-        if isinstance(x, NDArray):
-            arr = x.asnumpy()
-            lo, hi = float(arr.min()), float(arr.max())
-            cur = self.ranges.get(id(block))
-            if cur is None:
-                self.ranges[id(block)] = [lo, hi]
-            else:
-                cur[0] = min(cur[0], lo)
-                cur[1] = max(cur[1], hi)
+        if not isinstance(x, NDArray):
+            return
+        arr = x.asnumpy()
+        if self.collect_hist:
+            lo, hi = self.ranges[id(block)]
+            absmax = max(abs(lo), abs(hi), 1e-8)
+            hist, _ = np.histogram(arr, bins=self.NUM_BINS,
+                                   range=(-absmax, absmax))
+            cur = self.hists.get(id(block))
+            self.hists[id(block)] = hist if cur is None else cur + hist
+            return
+        lo, hi = float(arr.min()), float(arr.max())
+        cur = self.ranges.get(id(block))
+        if cur is None:
+            self.ranges[id(block)] = [lo, hi]
+        else:
+            cur[0] = min(cur[0], lo)
+            cur[1] = max(cur[1], hi)
+
+    def thresholds(self, calib_mode: str) -> Dict[int, List[float]]:
+        if calib_mode != "entropy":
+            return self.ranges
+        out = {}
+        for bid, (lo, hi) in self.ranges.items():
+            hist = self.hists.get(bid)
+            if hist is None:
+                out[bid] = [lo, hi]
+                continue
+            absmax = max(abs(lo), abs(hi), 1e-8)
+            edges = np.linspace(-absmax, absmax, self.NUM_BINS + 1)
+            th = _optimal_threshold_kl(hist, edges)
+            out[bid] = [-th, th]
+        return out
 
 
 def quantize_model(net, calib_data=None, quantized_dtype="int8",
-                   exclude_blocks=()):
+                   exclude_blocks=(), calib_mode="minmax",
+                   quantize_pooling=False):
     """Calibrate activation ranges over ``calib_data`` batches, then
-    replace every calibrated Dense/Conv2D with its int8 version (reference
-    ``quantize_model`` minmax calibration). Returns a new net sharing
-    unquantized children."""
+    replace every calibrated Dense/Conv2D (and, with
+    ``quantize_pooling=True``, Max/AvgPool2D) with its int8 version
+    (reference ``quantize_model``).
+
+    ``calib_mode``: ``'minmax'`` uses the observed range;
+    ``'entropy'`` runs the KL-threshold search over an 8001-bin
+    histogram (reference calib_mode='entropy') — tighter ranges when the
+    activation distribution has outlier tails."""
     if quantized_dtype != "int8":
         raise ValueError("only int8 is supported")
+    if calib_mode not in ("minmax", "entropy"):
+        raise ValueError(f"calib_mode {calib_mode!r}")
     collector = _CalibCollector()
-    dense_blocks = []
+    calib_types = (_nn.Dense, _nn.Conv2D)
+    if quantize_pooling:
+        calib_types = calib_types + (_nn.MaxPool2D, _nn.AvgPool2D)
+    hooked_blocks = []
     reactivate = []
 
     def attach(b):
-        if isinstance(b, (_nn.Dense, _nn.Conv2D)) and \
-                b not in exclude_blocks:
-            dense_blocks.append(b)
+        if isinstance(b, calib_types) and b not in exclude_blocks:
+            hooked_blocks.append(b)
             b.register_forward_pre_hook(collector.hook)
         # calibration must run EAGERLY: a warmed CachedOp would replay the
         # compiled graph and never fire the child pre-hooks
@@ -207,25 +409,35 @@ def quantize_model(net, calib_data=None, quantized_dtype="int8",
 
     net.apply(attach)
     try:
-        for batch in (calib_data or []):
-            net(batch if isinstance(batch, NDArray) else NDArray(
-                jnp.asarray(batch)))
+        passes = 2 if calib_mode == "entropy" else 1
+        for p in range(passes):
+            collector.collect_hist = p == 1
+            for batch in (calib_data or []):
+                net(batch if isinstance(batch, NDArray) else NDArray(
+                    jnp.asarray(batch)))
     finally:
-        for b in dense_blocks:
+        for b in hooked_blocks:
             b._forward_pre_hooks = [h for h in b._forward_pre_hooks
                                     if h != collector.hook]
         for b in reactivate:
             b._active = True          # recompiles (with new children) lazily
 
+    thresholds = collector.thresholds(calib_mode)
+
     def convert(block):
         block._cached_op = None       # children change under it
         for name, child in list(block._children.items()):
-            if id(child) in collector.ranges:
-                lo, hi = collector.ranges[id(child)]
+            if id(child) in thresholds:
+                lo, hi = thresholds[id(child)]
                 if isinstance(child, _nn.Conv2D):
                     q = QuantizedConv2D(child, lo, hi)
-                else:
+                elif isinstance(child, _nn.Dense):
                     q = QuantizedDense(child, lo, hi)
+                else:
+                    try:
+                        q = QuantizedPooling(child, lo, hi)
+                    except NotImplementedError:
+                        continue      # ceil_mode pool stays float
                 block._children[name] = q
                 setattr(block, name, q)
             else:

@@ -22,6 +22,9 @@ from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
                      ResNetV1, ResNetV2, get_resnet, resnet18_v1, resnet18_v2,
                      resnet34_v1, resnet34_v2, resnet50_v1, resnet50_v2,
                      resnet101_v1, resnet101_v2, resnet152_v1, resnet152_v2)
+from .fused_resnet import (FusedBottleneckV1, FusedResNetV1,
+                           fused_resnet50_v1, fused_resnet101_v1,
+                           fused_resnet152_v1)
 from .inception import Inception3, inception_v3
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .vgg import (VGG, vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16, vgg16_bn,

@@ -1,0 +1,275 @@
+"""ResNet v1 with Pallas-fused conv+BN bottlenecks (TPU fast path).
+
+Same architecture/parameters as :mod:`resnet` (He et al. 1512.03385,
+reference ``python/mxnet/gluon/model_zoo/vision/resnet.py``), but the
+training step never materialises a normalized activation in HBM inside a
+bottleneck: each conv applies the previous BatchNorm + ReLU as a VMEM
+prologue and emits its own BN statistics from the epilogue
+(``ops/pallas_conv.py`` — the cuDNN-fusion analog, built because
+PROFILE.md measured the separate BN passes at ~30% of the ResNet step).
+
+Layout divergences from the unfused zoo model (documented, deliberate):
+
+* weights are stored HWIO and activations flow NHWC (TPU-native; the
+  zoo model is NCHW/OIHW like the reference). `tests/test_fused_resnet.py`
+  maps parameters between the two layouts and proves numerical equality.
+* each bottleneck is ONE tape node (a pure jnp chain of three fused
+  convs + the residual join), so autograd replays it as a unit.
+
+The 7x7 stem (C_in=3 starves the MXU lane dimension) and the residual
+join run in plain XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ....ndarray import invoke
+from ... import HybridBlock
+from ...nn import Dense, HybridSequential
+from .... import autograd
+
+
+def _coeffs(y, s, ss, g, be, rm, rv, training, eps):
+    from ....ops.pallas_conv import bn_scale_shift
+
+    if training:
+        cnt = y.shape[0] * y.shape[1] * y.shape[2]
+        return bn_scale_shift(s, ss, cnt, g, be, eps)
+    inv = lax.rsqrt(rv.astype(jnp.float32) + eps)
+    a = g.astype(jnp.float32) * inv
+    b = be.astype(jnp.float32) - rm.astype(jnp.float32) * a
+    return a, b, rm, rv
+
+
+def _fused_bottleneck(x, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2, rv2,
+                      w3, g3, be3, rm3, rv3, *ds, stride=1, training=True,
+                      eps=1e-5, interpret=None):
+    """One ResNet v1 bottleneck, fully fused. x: (N, H, W, Cin) NHWC.
+
+    Returns ``out`` in eval mode; ``(out, m1, v1, m2, v2, m3, v3[, md,
+    vd])`` in training mode (batch stats for the running-stat updates).
+    """
+    from ....ops.pallas_conv import fused_conv_bn, pallas_conv_available
+
+    if interpret is None:
+        interpret = not pallas_conv_available()
+    y1, s1, ss1 = fused_conv_bn(x, w1, stride=1, pad=0, relu=False,
+                                interpret=interpret)
+    a1, b1, m1, v1 = _coeffs(y1, s1, ss1, g1, be1, rm1, rv1, training, eps)
+    y2, s2, ss2 = fused_conv_bn(y1, w2, a1, b1, stride=stride, pad=1,
+                                relu=True, interpret=interpret)
+    a2, b2, m2, v2 = _coeffs(y2, s2, ss2, g2, be2, rm2, rv2, training, eps)
+    y3, s3, ss3 = fused_conv_bn(y2, w3, a2, b2, stride=1, pad=0,
+                                relu=True, interpret=interpret)
+    a3, b3, m3, v3 = _coeffs(y3, s3, ss3, g3, be3, rm3, rv3, training, eps)
+    if ds:
+        wd, gd, bed, rmd, rvd = ds
+        yd, sd, ssd = fused_conv_bn(x, wd, stride=stride, pad=0,
+                                    relu=False, interpret=interpret)
+        ad, bd, md, vd = _coeffs(yd, sd, ssd, gd, bed, rmd, rvd, training,
+                                 eps)
+        shortcut = yd.astype(jnp.float32) * ad + bd
+    else:
+        shortcut = x.astype(jnp.float32)
+    out = jnp.maximum(y3.astype(jnp.float32) * a3 + b3 + shortcut, 0.0)
+    out = out.astype(x.dtype)
+    if training:
+        stats = (m1, v1, m2, v2, m3, v3) + ((md, vd) if ds else ())
+        return (out,) + stats
+    return out
+
+
+def _fused_stem(x, w, g, be, rm, rv, *, training=True, eps=1e-5):
+    """NCHW input -> NHWC; 7x7/2 conv + BN + ReLU + 3x3/2 maxpool, all in
+    XLA (C_in=3 wastes the MXU lanes; the stem is ~6% of the FLOPs)."""
+    x = jnp.transpose(x, (0, 2, 3, 1)).astype(w.dtype)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    # bf16 runs natively (f32 preferred_element_type would mix dtypes in
+    # the conv transpose — same constraint as _fused_conv_ref)
+    low_prec = x.dtype in (jnp.bfloat16, jnp.float16)
+    y = lax.conv_general_dilated(
+        x, w, (2, 2), [(3, 3), (3, 3)], dimension_numbers=dn,
+        preferred_element_type=None if low_prec else jnp.float32)
+    y = y.astype(jnp.float32)
+    if training:
+        mu = jnp.mean(y, axis=(0, 1, 2))
+        var = jnp.maximum(jnp.mean(y * y, axis=(0, 1, 2)) - mu * mu, 0.0)
+    else:
+        mu = rm.astype(jnp.float32)
+        var = rv.astype(jnp.float32)
+    out = jnp.maximum((y - mu) * lax.rsqrt(var + eps)
+                      * g.astype(jnp.float32)
+                      + be.astype(jnp.float32), 0.0).astype(x.dtype)
+    # scalar -inf literal: a materialized init array demotes this to the
+    # generic reduce_window primitive, which has no transpose rule
+    out = lax.reduce_window(
+        out, -jnp.inf, lax.max, (1, 3, 3, 1),
+        (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)])
+    if training:
+        return out, mu, var
+    return out
+
+
+def _global_pool(x):
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2)).astype(x.dtype)
+
+
+class _BNParams:
+    """Declare gamma/beta/running stats for one BN site on a block.
+    Parameters are also set as block attributes so Block.__setattr__
+    registers them in _reg_params (collect_params walks that)."""
+
+    def __init__(self, block, name, c):
+        self.gamma = block.params.get(f"{name}_gamma", shape=(c,),
+                                      init="ones")
+        self.beta = block.params.get(f"{name}_beta", shape=(c,),
+                                     init="zeros")
+        self.running_mean = block.params.get(
+            f"{name}_running_mean", shape=(c,), init="zeros",
+            grad_req="null")
+        self.running_var = block.params.get(
+            f"{name}_running_var", shape=(c,), init="ones",
+            grad_req="null")
+        setattr(block, f"{name}_gamma", self.gamma)
+        setattr(block, f"{name}_beta", self.beta)
+        setattr(block, f"{name}_running_mean", self.running_mean)
+        setattr(block, f"{name}_running_var", self.running_var)
+
+    def resolved(self, params, name):
+        return [params[f"{name}_gamma"], params[f"{name}_beta"],
+                params[f"{name}_running_mean"],
+                params[f"{name}_running_var"]]
+
+
+class FusedBottleneckV1(HybridBlock):
+    """Bottleneck v1 (stride on the 3x3, like the zoo BottleneckV1) over
+    the fused Pallas conv+BN kernels; weights HWIO, activations NHWC."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 epsilon=1e-5, momentum=0.9, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        c4 = channels // 4
+        self._stride = stride
+        self._eps = epsilon
+        self._momentum = momentum
+        self._has_ds = downsample
+        with self.name_scope():
+            self.conv1_weight = self.params.get(
+                "conv1_weight", shape=(1, 1, in_channels, c4),
+                init="xavier")
+            self.bn1 = _BNParams(self, "bn1", c4)
+            self.conv2_weight = self.params.get(
+                "conv2_weight", shape=(3, 3, c4, c4), init="xavier")
+            self.bn2 = _BNParams(self, "bn2", c4)
+            self.conv3_weight = self.params.get(
+                "conv3_weight", shape=(1, 1, c4, channels), init="xavier")
+            self.bn3 = _BNParams(self, "bn3", channels)
+            if downsample:
+                self.convd_weight = self.params.get(
+                    "convd_weight", shape=(1, 1, in_channels, channels),
+                    init="xavier")
+                self.bnd = _BNParams(self, "bnd", channels)
+
+    def forward(self, x, *args):
+        params = self._resolve_params(x)
+        training = autograd.is_training()
+        ins = [x, params["conv1_weight"]] + self.bn1.resolved(params, "bn1")
+        ins += [params["conv2_weight"]] + self.bn2.resolved(params, "bn2")
+        ins += [params["conv3_weight"]] + self.bn3.resolved(params, "bn3")
+        if self._has_ds:
+            ins += [params["convd_weight"]] + self.bnd.resolved(params,
+                                                                "bnd")
+        out = invoke(_fused_bottleneck, ins,
+                     kwargs=dict(stride=self._stride, training=training,
+                                 eps=self._eps),
+                     name="fused_bottleneck")
+        if training:
+            bns = [self.bn1, self.bn2, self.bn3] + (
+                [self.bnd] if self._has_ds else [])
+            out, *stats = out
+            m = self._momentum
+            for bn, (mean, var) in zip(bns, zip(stats[0::2], stats[1::2])):
+                bn.running_mean.set_data(
+                    bn.running_mean.data() * m + mean.detach() * (1 - m))
+                bn.running_var.set_data(
+                    bn.running_var.data() * m + var.detach() * (1 - m))
+        return out
+
+
+class FusedResNetV1(HybridBlock):
+    """ResNet v1 assembled from fused bottlenecks (50/101/152 depths)."""
+
+    def __init__(self, layers, channels, classes=1000, epsilon=1e-5,
+                 momentum=0.9, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = epsilon
+        self._momentum = momentum
+        with self.name_scope():
+            self.conv0_weight = self.params.get(
+                "conv0_weight", shape=(7, 7, 3, channels[0]), init="xavier")
+            self.bn0 = _BNParams(self, "bn0", channels[0])
+            self.stages = HybridSequential(prefix="")
+            with self.stages.name_scope():
+                for i, num_layer in enumerate(layers):
+                    stride = 1 if i == 0 else 2
+                    stage = HybridSequential(prefix=f"stage{i + 1}_")
+                    with stage.name_scope():
+                        # explicit unit prefixes: these blocks declare
+                        # fixed param names, so unlike the zoo's auto-
+                        # named child layers they must not share a scope
+                        stage.add(FusedBottleneckV1(
+                            channels[i + 1], stride,
+                            downsample=channels[i + 1] != channels[i],
+                            in_channels=channels[i], epsilon=epsilon,
+                            momentum=momentum, prefix="unit1_"))
+                        for j in range(num_layer - 1):
+                            stage.add(FusedBottleneckV1(
+                                channels[i + 1], 1, downsample=False,
+                                in_channels=channels[i + 1],
+                                epsilon=epsilon, momentum=momentum,
+                                prefix=f"unit{j + 2}_"))
+                    self.stages.add(stage)
+            self.output = Dense(classes, in_units=channels[-1])
+
+    def forward(self, x, *args):
+        params = self._resolve_params(x)
+        training = autograd.is_training()
+        stem = invoke(_fused_stem,
+                      [x, params["conv0_weight"]]
+                      + self.bn0.resolved(params, "bn0"),
+                      kwargs=dict(training=training, eps=self._eps),
+                      name="fused_stem")
+        if training:
+            stem, mu, var = stem
+            m = self._momentum
+            self.bn0.running_mean.set_data(
+                self.bn0.running_mean.data() * m + mu.detach() * (1 - m))
+            self.bn0.running_var.set_data(
+                self.bn0.running_var.data() * m + var.detach() * (1 - m))
+        feat = self.stages(stem)
+        pooled = invoke(_global_pool, [feat], name="global_avg_pool")
+        return self.output(pooled)
+
+
+def fused_resnet50_v1(classes=1000, **kwargs):
+    """ResNet-50 v1 with fused Pallas conv+BN bottlenecks — the TPU fast
+    path for BASELINE.json config[1]."""
+    return FusedResNetV1([3, 4, 6, 3], [64, 256, 512, 1024, 2048],
+                         classes=classes, **kwargs)
+
+
+def fused_resnet101_v1(classes=1000, **kwargs):
+    return FusedResNetV1([3, 4, 23, 3], [64, 256, 512, 1024, 2048],
+                         classes=classes, **kwargs)
+
+
+def fused_resnet152_v1(classes=1000, **kwargs):
+    return FusedResNetV1([3, 8, 36, 3], [64, 256, 512, 1024, 2048],
+                         classes=classes, **kwargs)

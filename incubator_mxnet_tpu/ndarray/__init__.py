@@ -596,3 +596,53 @@ def SoftmaxActivation(data, mode="instance"):
     """Deprecated reference op (softmax over channels or instances)."""
     axis = 1 if mode == "channel" else -1
     return softmax(data, axis=axis)  # noqa: F821
+
+
+# ---------------------------------------------------------------------------
+# round-4 registry-audit wave: legacy aliases + contrib additions
+# (see COVERAGE.md "Registry audit" table)
+# ---------------------------------------------------------------------------
+make_loss = _wrap("make_loss", 1)
+MakeLoss = make_loss
+BatchNorm_v1 = _wrap("BatchNorm_v1", 5)
+Pooling_v1 = _wrap("Pooling_v1", 1)
+ElementWiseSum = _wrap("ElementWiseSum", 0, variadic=True)
+broadcast_axes = _wrap("broadcast_axes", 1)
+broadcast_minus = _wrap("broadcast_minus", 2)
+broadcast_plus = _wrap("broadcast_plus", 2)
+max_axis = _wrap("max_axis", 1)
+min_axis = _wrap("min_axis", 1)
+sum_axis = _wrap("sum_axis", 1)
+ftml_update = _wrap_update("ftml_update", 5, 3)
+mp_nag_mom_update = _wrap_update("mp_nag_mom_update", 4, 2)
+multi_sum_sq = _wrap("multi_sum_sq", 0, variadic=True)
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero the inputs IN PLACE (reference reset_arrays is a mutate-only
+    op called for its side effect); also returns them."""
+    opdef = _registry.get("reset_arrays")
+    outs = invoke(opdef.fn, list(arrays),
+                  {"num_arrays": num_arrays}, name="reset_arrays",
+                  differentiable=False)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    for a, z in zip(arrays, outs):
+        a._set_data(z._data)
+    return outs if len(outs) > 1 else outs[0]
+
+# two-parameter pdfs take (sample, p1, p2); one-parameter (sample, p1)
+for _n in ("random_pdf_uniform", "random_pdf_normal", "random_pdf_gamma",
+           "random_pdf_negative_binomial",
+           "random_pdf_generalized_negative_binomial"):
+    setattr(_this, _n, _wrap(_n, 3))
+for _n in ("random_pdf_exponential", "random_pdf_poisson",
+           "random_pdf_dirichlet"):
+    setattr(_this, _n, _wrap(_n, 2))
+
+contrib.div_sqrt_dim = _wrap("div_sqrt_dim", 1)
+contrib.quadratic = _wrap("quadratic", 1)
+contrib.gradientmultiplier = _wrap("gradientmultiplier", 1)
+contrib.AdaptiveAvgPooling2D = _wrap("AdaptiveAvgPooling2D", 1)
+contrib.BatchNormWithReLU = _wrap("BatchNormWithReLU", 5)
+contrib.requantize = _wrap("requantize", 3)
+contrib.SparseEmbedding = _this.Embedding

@@ -273,3 +273,191 @@ def multi_all_finite(*arrays, num_arrays=None, init_output=True):
     for a in arrays:
         ok = jnp.logical_and(ok, jnp.isfinite(a).all())
     return ok.astype(jnp.float32).reshape(1)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 registry-audit additions (reference src/operator/optimizer_op.cc
+# names missing from the r3 registry; see COVERAGE.md audit table)
+# ---------------------------------------------------------------------------
+@register("ftml_update")
+def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """FTML (Follow the Moving Leader; reference ftml_update). Returns
+    (weight', d', v', z')."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    g = g + wd * weight
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    d2 = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t))
+                                  + epsilon)
+    sigma = d2 - beta1 * d
+    z2 = beta1 * z + (1 - beta1) * g - sigma * weight
+    w2 = -z2 / d2
+    return (w2.astype(weight.dtype), d2, v2, z2)
+
+
+@register("mp_nag_mom_update")
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """NAG with fp32 master weights (reference mp_nag_mom_update).
+    Returns (weight', mom', weight32')."""
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                  clip_gradient)
+    m2 = momentum * mom + g
+    w32 = weight32 - lr * (momentum * m2 + g)
+    return w32.astype(weight.dtype), m2, w32
+
+
+@register("mp_lamb_update_phase1")
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1, wd=0.0,
+                          rescale_grad=1.0, bias_correction=True):
+    """LAMB phase 1 on fp32 master weights. Returns (g_update, mean',
+    var')."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    m2 = beta1 * mean + (1 - beta1) * g
+    v2 = beta2 * var + (1 - beta2) * jnp.square(g)
+    mh, vh = m2, v2
+    if bias_correction:
+        mh = m2 / (1 - beta1 ** t)
+        vh = v2 / (1 - beta2 ** t)
+    gup = mh / (jnp.sqrt(vh) + epsilon) + wd * weight32
+    return gup, m2, v2
+
+
+@register("mp_lamb_update_phase2")
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, lr=0.001,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    """LAMB phase 2 on fp32 master weights. Returns (weight', weight32')."""
+    r1c = r1
+    if lower_bound > 0:
+        r1c = jnp.maximum(r1c, lower_bound)
+    if upper_bound > 0:
+        r1c = jnp.minimum(r1c, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1c > 0, r2 > 0), r1c / r2, 1.0)
+    w32 = weight32 - lr * ratio * g_update
+    return w32.astype(weight.dtype), w32
+
+
+@register("multi_sum_sq", differentiable=False)
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-tensor sum of squares, one scalar per input (reference
+    multi_sum_sq — the LARS norm pass), returned as a (n,) vector."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+@register("multi_lars", differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-9, rescale_grad=1.0):
+    """LARS layer-wise lr scaling (reference multi_lars): lr_i *= eta *
+    ||w_i|| / (||g_i|| + wd_i * ||w_i|| + eps) where both norms > 0."""
+    wn = jnp.sqrt(weights_sum_sq)
+    gn = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * wn / (gn + wds * wn + eps)
+    return lrs * jnp.where(jnp.logical_and(wn > 0, gn > 0), ratio, 1.0)
+
+
+@register("multi_mp_sgd_update")
+def multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    """args = (w0, g0, w32_0, ...); returns (w0', w32_0', ...)."""
+    outs = []
+    n = num_weights if num_weights is not None else len(args) // 3
+    for i in range(n):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, w322 = mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        outs.extend([w2, w322])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update")
+def multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    """args = (w0, g0, m0, w32_0, ...); returns (w0', m0', w32_0', ...)."""
+    outs = []
+    n = num_weights if num_weights is not None else len(args) // 4
+    for i in range(n):
+        w, g, m, w32 = args[4 * i: 4 * i + 4]
+        w2, m2, w322 = mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend([w2, m2, w322])
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_update")
+def preloaded_multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=None):
+    """Like multi_sgd_update but lrs/wds arrive as device ARRAYS (the last
+    two operands) instead of attributes (reference preloaded_multi_*)."""
+    lrs, wds = args[-2], args[-1]
+    body = args[:-2]
+    n = num_weights if num_weights is not None else len(body) // 2
+    outs = []
+    for i in range(n):
+        w, g = body[2 * i], body[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update")
+def preloaded_multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None):
+    lrs, wds = args[-2], args[-1]
+    body = args[:-2]
+    n = num_weights if num_weights is not None else len(body) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = body[3 * i], body[3 * i + 1], body[3 * i + 2]
+        w2, m2 = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([w2, m2])
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_update")
+def preloaded_multi_mp_sgd_update(*args, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=None):
+    lrs, wds = args[-2], args[-1]
+    body = args[:-2]
+    n = num_weights if num_weights is not None else len(body) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = body[3 * i], body[3 * i + 1], body[3 * i + 2]
+        w2, w322 = mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        outs.extend([w2, w322])
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_mom_update")
+def preloaded_multi_mp_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1.0, num_weights=None):
+    lrs, wds = args[-2], args[-1]
+    body = args[:-2]
+    n = num_weights if num_weights is not None else len(body) // 4
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = body[4 * i: 4 * i + 4]
+        w2, m2, w322 = mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend([w2, m2, w322])
+    return tuple(outs)
+
+
+@register("reset_arrays", differentiable=False)
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every input array (reference reset_arrays — gradient-buffer
+    clearing between accumulation windows)."""
+    return tuple(jnp.zeros_like(a) for a in arrays)

@@ -79,13 +79,24 @@ def _fused_conv_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, ss_ref, *,
         x = jnp.pad(x, ((0, 0), (pad, pad + stride - 1),
                         (pad, pad + stride - 1), (0, 0)))
 
+    if stride > 1:
+        # phase decomposition: one reshape into stride-phases, then every
+        # tap is a PLAIN slice (offset strided slices at tap offsets are
+        # rejected by the Mosaic compiler). nb == 1 for strided convs.
+        s = stride
+        hp, wp = x.shape[1], x.shape[2]
+        hp -= hp % s
+        wp -= wp % s
+        xph = x[0, :hp, :wp, :].reshape(hp // s, s, wp // s, s, ci)
+
     def tap(ky, kx):
         if stride == 1:
             xs = x[:, ky:ky + ho, kx:kx + wo, :]
         else:
             s = stride
-            xs = x[:, ky:ky + s * ho, kx:kx + s * wo, :].reshape(
-                nb, ho, s, wo, s, ci)[:, :, 0, :, 0, :]
+            qy, ry = ky // s, ky % s
+            qx, rx = kx // s, kx % s
+            xs = xph[qy:qy + ho, ry, qx:qx + wo, rx, :]
         return xs.reshape(nb * ho * wo, ci)
 
     if im2col and (kh, kw) != (1, 1):
@@ -120,30 +131,30 @@ def _out_size(h, pad, k, stride):
 
 
 def _fused_conv_ref(x, w, a, b, stride, pad, relu):
-    """XLA formulation with identical math (prologue in fp32, conv
-    accumulated in fp32, stats off the fp32 accumulator). Oracle for tests
-    and the linearization point for the backward pass."""
-    if a is not None:
-        xf = x.astype(jnp.float32) * a + b
-        if relu:
-            xf = jnp.maximum(xf, 0.0)
-        x = xf.astype(x.dtype)
-    dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NHWC", "HWIO", "NHWC"))
-    y32 = lax.conv_general_dilated(
-        x, w, window_strides=(stride, stride),
-        padding=[(pad, pad), (pad, pad)], dimension_numbers=dn,
-        preferred_element_type=jnp.float32, precision=_prec(x.dtype))
+    """XLA formulation with matching math (prologue in fp32, fp32-
+    accumulated conv, stats in fp32). Oracle for tests; the backward
+    linearizes through :func:`_conv_part_ref` (the same body minus the
+    stats)."""
+    y = _conv_part_ref(x, w, a, b, stride, pad, relu)
+    y32 = y.astype(jnp.float32)
     s = jnp.sum(y32, axis=(0, 1, 2))
     ss = jnp.sum(y32 * y32, axis=(0, 1, 2))
     return y32.astype(x.dtype), s, ss
 
 
-def _pick_nb(n, ho, wo):
+def _pick_nb(n, ho, wo, *, per_image_bytes=0, fixed_bytes=0, stride=1):
     """Images per grid program: aim for ~1-2k matmul rows so the MXU's
-    M dimension is well fed even at 7x7 spatial sizes."""
+    M dimension is well fed even at 7x7 spatial sizes, capped so the
+    per-program working set stays under the VMEM budget (v5e has ~16 MB;
+    nb=32 at the layer-4 shapes crashes the Mosaic compile helper).
+    Strided convs use nb=1 — the 6-D strided slice-reshape is rejected."""
+    if stride > 1:
+        return 1
     target = 2048
     nb = max(1, target // max(ho * wo, 1))
+    budget = 10 * 1024 * 1024
+    if per_image_bytes:
+        nb = min(nb, max(1, (budget - fixed_bytes) // per_image_bytes))
     while n % nb:
         nb -= 1
     return nb
@@ -161,10 +172,20 @@ def _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret):
     if not has_pro:  # dummy operands keep one kernel signature
         a = jnp.ones((ci,), jnp.float32)
         b = jnp.zeros((ci,), jnp.float32)
-    nb = _pick_nb(n, ho, wo)
+    esz = 2 if x.dtype in (jnp.bfloat16, jnp.float16) else 4
+    # double-buffered x and y blocks + the fp32 accumulator, per image
+    per_img = 2 * ((h + 2 * pad) * (wdt + 2 * pad) * ci
+                   + ho * wo * co) * esz + ho * wo * co * 4
+    nb = _pick_nb(n, ho, wo, per_image_bytes=per_img,
+                  fixed_bytes=kh * kw * ci * co * esz, stride=stride)
     # deep-contraction im2col pays off when the per-tap contraction is
-    # shallower than the MXU's 128 lanes
-    im2col = ci < 128 and (kh, kw) != (1, 1)
+    # shallower than the MXU's 128 lanes — but the VMEM concatenate
+    # currently trips a Mosaic layout bug ("result/input offset mismatch
+    # on non-concat dimension") for some channel counts, so it is opt-in
+    import os
+
+    im2col = (os.environ.get("MXTPU_CONV_IM2COL", "0") == "1"
+              and ci < 128 and (kh, kw) != (1, 1))
 
     kernel = functools.partial(
         _fused_conv_kernel, stride=stride, pad=pad, relu=relu, kh=kh,
@@ -199,23 +220,57 @@ def _fused_conv(x, w, a, b, stride, pad, relu, interpret):
     return _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
 
 
+def _conv_part_ref(x, w, a, b, stride, pad, relu):
+    """Prologue + conv only (no stats) — the single XLA body shared by the
+    test oracle (_fused_conv_ref) and the backward linearization.
+
+    For bf16/f16 inputs the conv runs NATIVELY in the input dtype (the
+    MXU still accumulates fp32 internally; only the output rounds) —
+    ``preferred_element_type=f32`` would make the conv's transpose rule
+    mix f32 cotangents with bf16 operands, which lax.conv rejects, and
+    would silently make every backward conv f32 (2-8x slower)."""
+    if a is not None:
+        xf = x.astype(jnp.float32) * a + b
+        if relu:
+            xf = jnp.maximum(xf, 0.0)
+        x = xf.astype(x.dtype)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    low_prec = x.dtype in (jnp.bfloat16, jnp.float16)
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)], dimension_numbers=dn,
+        preferred_element_type=None if low_prec else jnp.float32,
+        precision=_prec(x.dtype))
+
+
 def _fused_conv_fwd(x, w, a, b, stride, pad, relu, interpret):
     out = _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
-    return out, (x, w, a, b)
+    y = out[0]
+    return out, (x, w, a, b, y)
 
 
 def _fused_conv_bwd(stride, pad, relu, interpret, res, cts):
-    x, w, a, b = res
+    """Fold the stats cotangents into the output cotangent by hand —
+    ``d(sum)/dy = 1`` and ``d(sumsq)/dy = 2y`` with the SAVED kernel
+    output — then transpose only prologue+conv. Differentiating the ref's
+    stats directly would make XLA recompute the whole forward conv in the
+    backward (ss's vjp needs y), which measured ~2x on ResNet-50."""
+    x, w, a, b, y = res
+    dy, ds, dss = cts
+    dy_t = (dy.astype(jnp.float32) + ds[None, None, None, :]
+            + 2.0 * y.astype(jnp.float32) * dss[None, None, None, :])
+    dy_t = dy_t.astype(y.dtype)
     if a is None:
         _, vjp = jax.vjp(
-            lambda x_, w_: _fused_conv_ref(x_, w_, None, None, stride, pad,
-                                           relu), x, w)
-        dx, dw = vjp(cts)
+            lambda x_, w_: _conv_part_ref(x_, w_, None, None, stride, pad,
+                                          relu), x, w)
+        dx, dw = vjp(dy_t)
         return dx, dw, None, None
     _, vjp = jax.vjp(
-        lambda x_, w_, a_, b_: _fused_conv_ref(x_, w_, a_, b_, stride, pad,
-                                               relu), x, w, a, b)
-    return vjp(cts)
+        lambda x_, w_, a_, b_: _conv_part_ref(x_, w_, a_, b_, stride, pad,
+                                              relu), x, w, a, b)
+    return vjp(dy_t)
 
 
 _fused_conv.defvjp(_fused_conv_fwd, _fused_conv_bwd)

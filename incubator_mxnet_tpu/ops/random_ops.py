@@ -78,3 +78,102 @@ def multinomial(data, shape=(), get_prob=False, dtype=jnp.int32, rng=None):
 @register("shuffle", differentiable=False, needs_rng=True)
 def shuffle(x, rng=None):
     return jax.random.permutation(rng, x, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 registry-audit additions: the random_pdf_* family + negative-
+# binomial samplers (reference src/operator/random/pdf_op.cc,
+# sample_op.cc; see COVERAGE.md audit table)
+# ---------------------------------------------------------------------------
+def _maybe_log(v, is_log):
+    return v if is_log else jnp.exp(v)
+
+
+@register("random_pdf_uniform")
+def random_pdf_uniform(sample, low, high, is_log=False):
+    logpdf = jnp.where(
+        (sample >= low) & (sample <= high),
+        -jnp.log(high - low), -jnp.inf)
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_normal")
+def random_pdf_normal(sample, mu, sigma, is_log=False):
+    z = (sample - mu) / sigma
+    logpdf = -0.5 * z * z - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi)
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_gamma")
+def random_pdf_gamma(sample, alpha, beta, is_log=False):
+    """Shape/rate parametrization (reference pdf_op.cc PDF_Gamma)."""
+    logpdf = (alpha * jnp.log(beta) + (alpha - 1) * jnp.log(sample)
+              - beta * sample - jax.lax.lgamma(alpha))
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_exponential")
+def random_pdf_exponential(sample, lam, is_log=False):
+    logpdf = jnp.log(lam) - lam * sample
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_poisson")
+def random_pdf_poisson(sample, lam, is_log=False):
+    logpdf = (sample * jnp.log(lam) - lam
+              - jax.lax.lgamma(sample + 1.0))
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_negative_binomial")
+def random_pdf_negative_binomial(sample, k, p, is_log=False):
+    """P(X=x) = C(x+k-1, x) p^k (1-p)^x (reference parametrization:
+    k failures, success prob p)."""
+    logpdf = (jax.lax.lgamma(sample + k) - jax.lax.lgamma(sample + 1.0)
+              - jax.lax.lgamma(k) + k * jnp.log(p)
+              + sample * jnp.log1p(-p))
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_generalized_negative_binomial")
+def random_pdf_generalized_negative_binomial(sample, mu, alpha,
+                                             is_log=False):
+    """Mean/dispersion parametrization (reference PDF_GeneralizedNegative
+    Binomial): k = 1/alpha, p = k/(k+mu)."""
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return random_pdf_negative_binomial(sample, k, p, is_log=is_log)
+
+
+@register("random_pdf_dirichlet")
+def random_pdf_dirichlet(sample, alpha, is_log=False):
+    logpdf = (jnp.sum((alpha - 1) * jnp.log(sample), axis=-1)
+              + jax.lax.lgamma(jnp.sum(alpha, axis=-1))
+              - jnp.sum(jax.lax.lgamma(alpha), axis=-1))
+    return _maybe_log(logpdf, is_log)
+
+
+def _sample_nb(rng, k, p, shape, dtype):
+    """Gamma-Poisson mixture: lam ~ Gamma(k, (1-p)/p); X ~ Poisson(lam)."""
+    kr, kp = jax.random.split(rng)
+    lam = jax.random.gamma(kr, jnp.broadcast_to(k, shape)) * (1 - p) / p
+    return jax.random.poisson(kp, lam, tuple(shape)).astype(dtype)
+
+
+@register("random_negative_binomial", differentiable=False, needs_rng=True,
+          aliases=("sample_negative_binomial", "negative_binomial"))
+def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype=jnp.float32,
+                             rng=None):
+    return _sample_nb(rng, jnp.asarray(k, jnp.float32),
+                      jnp.asarray(p, jnp.float32), tuple(shape), dtype)
+
+
+@register("random_generalized_negative_binomial", differentiable=False,
+          needs_rng=True,
+          aliases=("sample_generalized_negative_binomial",
+                   "generalized_negative_binomial"))
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                         dtype=jnp.float32, rng=None):
+    k = 1.0 / jnp.asarray(alpha, jnp.float32)
+    p = k / (k + jnp.asarray(mu, jnp.float32))
+    return _sample_nb(rng, k, p, tuple(shape), dtype)

@@ -19,5 +19,6 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
 from .collectives import (allreduce_across_processes, allreduce_arrays,
                           init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
-from .pipeline import PipelineTrainer, pipeline_apply, stack_stage_params
+from .pipeline import (PipelineTrainer, pipeline_apply,
+                       pipeline_apply_1f1b, stack_stage_params)
 from .checkpoint import restore_sharded, save_sharded

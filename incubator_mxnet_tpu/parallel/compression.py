@@ -1,0 +1,82 @@
+"""2-bit gradient compression with error feedback.
+
+Reference semantic (``src/kvstore/gradient_compression.cc``): each value
+of (gradient + residual) maps to one of three codes — ``+threshold`` if
+>= threshold, ``-threshold`` if <= -threshold, else 0 — packed four codes
+per byte (16x less wire traffic than fp32, 4x less than int8); whatever
+the code did NOT transmit stays in a local residual that is added to the
+next step's gradient (error feedback), so the compressed sum converges to
+the true sum over time.
+
+The transport here is the compiled cross-process collective
+(`collectives.allreduce_arrays`): every process contributes its packed
+payload, and unpack -> dequantize -> sum runs inside the jitted
+computation over the proc mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_CODE_POS = 1
+_CODE_NEG = 2
+
+
+def quantize_2bit(g: jax.Array, threshold: float,
+                  residual: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(gradient, residual) -> (packed uint8 codes, new residual).
+
+    Packed length is ceil(n/4); the caller keeps the original shape."""
+    gf = g.astype(jnp.float32) + residual
+    pos = gf >= threshold
+    neg = gf <= -threshold
+    deq = jnp.where(pos, threshold, 0.0) + jnp.where(neg, -threshold, 0.0)
+    new_residual = gf - deq
+    codes = (jnp.where(pos, _CODE_POS, 0)
+             + jnp.where(neg, _CODE_NEG, 0)).astype(jnp.uint8)
+    flat = codes.reshape(-1)
+    pad = (-flat.size) % 4
+    flat = jnp.pad(flat, (0, pad))
+    quads = flat.reshape(-1, 4)
+    packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+              | (quads[:, 3] << 6))
+    return packed, new_residual
+
+
+def dequantize_2bit(packed: jax.Array, shape, threshold: float,
+                    dtype=jnp.float32) -> jax.Array:
+    """Packed uint8 codes -> dequantized values of ``shape``."""
+    import numpy as np
+
+    n = int(np.prod(shape)) if shape else 1
+    quads = jnp.stack([(packed >> s) & 3 for s in (0, 2, 4, 6)], axis=-1)
+    codes = quads.reshape(-1)[:n]
+    vals = jnp.where(codes == _CODE_POS, threshold,
+                     jnp.where(codes == _CODE_NEG, -threshold, 0.0))
+    return vals.reshape(shape).astype(dtype)
+
+
+class GradientCompression:
+    """Stateful per-key error-feedback store (the reference
+    ``GradientCompression`` object owned by the kvstore)."""
+
+    def __init__(self, threshold: float = 0.5):
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        self.threshold = float(threshold)
+        self._residuals: Dict[object, jax.Array] = {}
+
+    def compress(self, key, g: jax.Array) -> jax.Array:
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, jnp.float32)
+        packed, new_res = quantize_2bit(g, self.threshold, res)
+        self._residuals[key] = new_res
+        return packed
+
+    def decompress(self, packed: jax.Array, shape,
+                   dtype=jnp.float32) -> jax.Array:
+        return dequantize_2bit(packed, shape, self.threshold, dtype)

@@ -88,6 +88,13 @@ def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if data_axis is not None and (B // M) % mesh.shape[data_axis]:
+        # ADVICE r3: surface this here instead of as an opaque shard_map
+        # axis-size error deep inside jax
+        raise ValueError(
+            f"microbatch size {B // M} (batch {B} / {M} microbatches) not "
+            f"divisible by data axis {data_axis!r} size "
+            f"{mesh.shape[data_axis]}")
     x_mb = x.reshape(M, B // M, *x.shape[1:])
     T = M + S - 1
     ring = [(i, (i + 1) % S) for i in range(S)]
@@ -128,6 +135,130 @@ def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
     return y_mb.reshape(B, *y_mb.shape[2:])
 
 
+def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
+                        *, mesh: Mesh,
+                        num_microbatches: Optional[int] = None,
+                        pipe_axis: str = PIPE_AXIS,
+                        data_axis: Optional[str] = None):
+    """One-forward-one-backward (1F1B) schedule: forward AND backward of
+    different microbatches interleave in ONE ``lax.scan``, with the loss
+    applied per-microbatch at the last stage.
+
+    Versus GPipe-under-``jax.grad`` (``pipeline_apply``), which lets XLA
+    save one residual set per scan tick — O(M + S - 1) live activation
+    sets per device — this schedule hand-carries a circular stash of at
+    most ``2(S-1)+1`` stage inputs and recomputes each stage's vjp at
+    backward time, so activation memory is bounded by the PIPELINE DEPTH,
+    not the microbatch count (the Megatron 1F1B property; PipeDream-Flush
+    / Narayanan et al. 2021). Bubble fraction is the same 2(S-1) ticks
+    per 2M work ticks — see docs/PIPELINE.md for the measured table.
+
+    Returns ``(mean_loss, dx, stage_grads)`` where ``dx`` is the
+    cotangent of ``x`` (shape of ``x``) and ``stage_grads`` mirrors
+    ``stacked_params`` (stage-stacked, sharded over ``pipe_axis``).
+    """
+    S = mesh.shape[pipe_axis]
+    n_stages = {int(np.shape(a)[0]) for a in jax.tree.leaves(stacked_params)}
+    if n_stages != {S}:
+        raise ValueError(
+            f"stacked stage axis {sorted(n_stages)} must equal the pipe "
+            f"axis size {S}")
+    M = int(num_microbatches or S)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if data_axis is not None and (B // M) % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch size {B // M} (batch {B} / {M} microbatches) not "
+            f"divisible by data axis {data_axis!r} size "
+            f"{mesh.shape[data_axis]}")
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    y_mb = labels.reshape(M, B // M, *labels.shape[1:])
+    n_data = mesh.shape[data_axis] if data_axis is not None else 1
+    T = M + 2 * (S - 1)
+    K = 2 * (S - 1) + 1               # max in-flight microbatches/device
+    fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+    bwd_ring = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_device(params, mb, lbl):
+        params = jax.tree.map(lambda a: a[0], params)
+        idx = lax.axis_index(pipe_axis)
+        is_last = idx == S - 1
+
+        def tick(carry, t):
+            state_f, state_b, stash, grad_acc, dx_acc, loss_acc = carry
+            m_f = t - idx
+            active_f = jnp.logical_and(m_f >= 0, m_f < M)
+            inj = mb[jnp.clip(m_f, 0, M - 1)]
+            cur = jnp.where(idx == 0, inj, state_f)
+            stash = jnp.where(active_f,
+                              stash.at[jnp.mod(m_f, K)].set(cur), stash)
+            y = stage_fn(params, cur)
+            lbl_m = lbl[jnp.clip(m_f, 0, M - 1)]
+            loss_m, dy = jax.value_and_grad(
+                lambda yy: per_mb_loss(yy, lbl_m))(y)
+            # total loss = mean over microbatches AND over data replicas;
+            # the cotangent carries both factors so dx comes out in
+            # global-loss units (grads then psum over data)
+            dy = dy / (M * n_data)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(is_last, active_f), loss_m, 0.0)
+
+            # backward slot: mb m_b finished its fwd here 2(S-1-idx)
+            # ticks ago; its cotangent arrives now (same tick, for the
+            # last stage, straight from the loss)
+            m_b = t - 2 * (S - 1) + idx
+            active_b = jnp.logical_and(m_b >= 0, m_b < M)
+            x_saved = stash[jnp.mod(m_b, K)]
+            cot = jnp.where(is_last, dy.astype(y.dtype), state_b)
+            _, vjp = jax.vjp(stage_fn, params, x_saved)
+            dparams, dx = vjp(cot)
+            grad_acc = jax.tree.map(
+                lambda a, d: a + jnp.where(active_b, d, 0.0),
+                grad_acc, dparams)
+            dx_acc = jnp.where(
+                jnp.logical_and(active_b, idx == 0),
+                dx_acc.at[jnp.clip(m_b, 0, M - 1)].set(dx), dx_acc)
+
+            state_f = lax.ppermute(y, pipe_axis, fwd_ring)
+            state_b = lax.ppermute(jnp.where(active_b, dx, 0.0),
+                                   pipe_axis, bwd_ring)
+            return (state_f, state_b, stash, grad_acc, dx_acc,
+                    loss_acc), None
+
+        init = (jnp.zeros_like(mb[0]),
+                jnp.zeros_like(mb[0]),
+                jnp.zeros((K,) + mb.shape[1:], mb.dtype),
+                jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             params),
+                jnp.zeros_like(mb),
+                jnp.zeros((), jnp.float32))
+        (_, _, _, grad_acc, dx_acc, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(T))
+        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), pipe_axis) / M
+        dx_out = lax.psum(jnp.where(idx == 0, dx_acc, 0.0), pipe_axis)
+        if data_axis is not None:
+            # DP composition: every data replica saw only its shard —
+            # reduce loss and parameter grads across the data axis (dx
+            # stays per-shard; its out_spec carries the data axis, and
+            # its 1/n_data factor is already in the cotangent)
+            loss = lax.pmean(loss, data_axis)
+            grad_acc = jax.tree.map(
+                lambda g: lax.psum(g, data_axis), grad_acc)
+        grads = jax.tree.map(lambda g: g[None], grad_acc)  # restack
+        return loss, dx_out, grads
+
+    pspec = jax.tree.map(lambda _: PartitionSpec(pipe_axis), stacked_params)
+    mb_spec = PartitionSpec(None, data_axis) if data_axis else \
+        PartitionSpec()
+    loss_v, dx_mb, grads = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pspec, mb_spec, mb_spec),
+        out_specs=(PartitionSpec(), mb_spec, pspec),
+        check_vma=False)(stacked_params, x_mb, y_mb)
+    return loss_v, dx_mb.reshape(x.shape), grads
+
+
 class PipelineTrainer:
     """Train ``prologue -> [stage]*S -> epilogue`` with the stage list
     pipelined over the ``pipe`` mesh axis; fused jitted step like
@@ -153,7 +284,19 @@ class PipelineTrainer:
                  num_microbatches: Optional[int] = None,
                  pipe_axis: str = PIPE_AXIS,
                  data_axis: Optional[str] = DATA_AXIS,
-                 donate: bool = True):
+                 donate: bool = True,
+                 schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                             f"got {schedule!r}")
+        if schedule == "1f1b" and epilogue is not None:
+            # 1F1B applies the loss per-microbatch AT the last stage; a
+            # replicated whole-batch epilogue would force the GPipe
+            # all-microbatches-first structure back
+            raise ValueError("schedule='1f1b' does not support an "
+                             "epilogue block; fold it into the last "
+                             "stage or the loss_fn")
+        self.schedule = schedule
         self.mesh = mesh if mesh is not None else make_mesh(
             {pipe_axis: len(stages)})
         S = self.mesh.shape[pipe_axis]
@@ -215,7 +358,54 @@ class PipelineTrainer:
             self.mesh, PartitionSpec(self.data_axis) if self.data_axis
             else PartitionSpec())
 
+    def _build_step_1f1b(self):
+        template = self.stages[0]
+        stage_objs = self._stage_objs
+        pro, pro_objs = self.prologue, self._pro_objs
+        loss_fn, tx, mesh = self.loss_fn, self.tx, self.mesh
+        pipe_axis, data_axis = self.pipe_axis, self.data_axis
+        M = self.num_microbatches
+
+        def stage_fn(pvals, h):
+            out, _ = functional_apply(template, stage_objs, pvals, h)
+            return out
+
+        def per_mb_loss(h, y):
+            with autograd._RecordingStateScope(False, True):
+                val = loss_fn(NDArray(h), NDArray(y))
+            return jnp.mean(val._data.astype(jnp.float32))
+
+        def step(params, frozen, opt_state, rng, x, y):
+            merged_stages = {**params["stages"], **frozen["stages"]}
+            with _random.key_provider(rng):
+                h = x
+                if pro is not None:
+                    def pro_fn(pp, xx):
+                        out, aux = functional_apply(
+                            pro, pro_objs, {**pp, **frozen["prologue"]},
+                            xx)
+                        return out
+                    h, vjp_pro = jax.vjp(pro_fn, params["prologue"], x)
+                loss, dh, stage_grads = pipeline_apply_1f1b(
+                    stage_fn, merged_stages, h, y, per_mb_loss,
+                    mesh=mesh, num_microbatches=M, pipe_axis=pipe_axis,
+                    data_axis=data_axis)
+                grads = {"stages": {
+                    n: stage_grads[n].astype(params["stages"][n].dtype)
+                    for n in params["stages"]},
+                    "prologue": {}, "epilogue": {}}
+                if pro is not None:
+                    grads["prologue"] = vjp_pro(dh.astype(h.dtype))[0]
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, frozen, opt_state, loss
+
+        return jax.jit(step,
+                       donate_argnums=(0, 1, 2) if self._donate else ())
+
     def _build_step(self):
+        if self.schedule == "1f1b":
+            return self._build_step_1f1b()
         template = self.stages[0]
         stage_objs = self._stage_objs
         pro, epi = self.prologue, self.epilogue

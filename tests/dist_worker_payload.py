@@ -228,6 +228,42 @@ def main() -> int:
         b = np.asarray(tr_b.params[n].addressable_data(0))
         np.testing.assert_array_equal(a, b)
 
+    # ---- 2-bit compressed allreduce: error feedback + keyed residuals ----
+    kv7 = mx.kvstore.create("dist_sync")
+    kv7.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv7.init("a", mx.nd.zeros((4,)))
+    kv7.init("b", mx.nd.zeros((4,)))
+    # same shapes, different values: residuals must NOT cross-contaminate
+    ga = mx.nd.ones((4,)) * 0.2     # below threshold: first push sends 0
+    gb = mx.nd.ones((4,)) * 0.3
+    outs_a, outs_b = [], []
+    for _ in range(10):
+        oa, ob = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+        kv7.pushpull("a", ga, out=oa)
+        kv7.pushpull("b", gb, out=ob)
+        outs_a.append(oa.asnumpy())
+        outs_b.append(ob.asnumpy())
+    # error feedback: totals approach the true sums, per key
+    np.testing.assert_allclose(np.sum(outs_a, axis=0),
+                               0.2 * size * 10, atol=0.5 * size)
+    np.testing.assert_allclose(np.sum(outs_b, axis=0),
+                               0.3 * size * 10, atol=0.5 * size)
+
+    # sparse push under 2bit: the touched-row MASK must bypass the lossy
+    # compressor (code-review r4 finding), so single-worker rows survive
+    kv8 = mx.kvstore.create("dist_sync")
+    kv8.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv8.init("emb2", mx.nd.zeros((6, 2)))
+    rows8 = np.array([rank])          # each rank touches only its row
+    vals8 = np.full((1, 2), 2.0, np.float32)
+    kv8.push("emb2", row_sparse_array((vals8, rows8), shape=(6, 2)))
+    p8 = mx.nd.zeros((6, 2))
+    kv8.pull("emb2", out=p8)
+    got8 = p8.asnumpy()
+    for r in range(size):
+        assert abs(got8[r, 0] - 2.0) <= 1.5, (r, got8)  # row survived
+    assert np.all(got8[size:] == 0.0)
+
     print(f"RANK {rank}/{size} OK", flush=True)
     return 0
 

@@ -166,10 +166,14 @@ def test_quantized_fc_int32_accumulation():
 # ---------------------------------------------------------------------------
 def test_set_gradient_compression_api():
     kv = mx.kvstore.create("dist_sync")
+    # round 4: '2bit' is the real reference semantic (error feedback),
+    # no longer an alias of int8 — see tests/test_gradient_compression.py
     kv.set_gradient_compression({"type": "2bit"})
-    assert kv._compression == "int8"
+    assert kv._compression == "2bit"
+    assert kv._compressor is not None
     kv.set_gradient_compression({"type": "int8"})
     assert kv._compression == "int8"
+    assert kv._compressor is None
     with pytest.raises(ValueError):
         kv.set_gradient_compression({"type": "fp4"})
 

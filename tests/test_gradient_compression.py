@@ -1,0 +1,91 @@
+"""2-bit gradient compression with error feedback (reference
+``src/kvstore/gradient_compression.cc`` semantic; VERDICT r4 item 8)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.parallel.compression import (GradientCompression,
+                                                      dequantize_2bit,
+                                                      quantize_2bit)
+
+
+def test_pack_unpack_roundtrip():
+    rs = np.random.RandomState(0)
+    g = jnp.asarray(rs.randn(7, 13).astype(np.float32))
+    res = jnp.zeros((7, 13), jnp.float32)
+    packed, new_res = quantize_2bit(g, 0.5, res)
+    # 16x wire compression: ceil(91/4) bytes vs 91*4
+    assert packed.dtype == jnp.uint8 and packed.size == (91 + 3) // 4
+    deq = dequantize_2bit(packed, (7, 13), 0.5)
+    gn = np.asarray(g)
+    expect = np.where(gn >= 0.5, 0.5, np.where(gn <= -0.5, -0.5, 0.0))
+    np.testing.assert_allclose(np.asarray(deq), expect)
+    # residual holds exactly what was not transmitted
+    np.testing.assert_allclose(np.asarray(new_res), gn - expect,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_error_feedback_recovers_signal():
+    """Summed dequantized updates converge to the true gradient sum: the
+    defining property of error feedback (a value of 0.2 with threshold
+    0.5 transmits 0, 0, +0.5, 0, 0, +0.5 ... averaging to ~0.2)."""
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.full((4,), 0.2, jnp.float32)
+    total = np.zeros(4, np.float32)
+    for _ in range(50):
+        packed = gc.compress("w", g)
+        total += np.asarray(gc.decompress(packed, (4,)))
+    np.testing.assert_allclose(total / 50, np.full(4, 0.2), atol=0.02)
+
+
+def test_allreduce_2bit_single_process_path():
+    from incubator_mxnet_tpu.parallel.collectives import allreduce_arrays
+
+    # threshold ABOVE the value scale: ternarization can transmit at most
+    # +/-threshold per step, so error feedback only recovers signals with
+    # |mean| < threshold (same property as the reference scheme)
+    gc = GradientCompression(threshold=0.5)
+    x = jnp.asarray(np.array([0.25, -0.3, 0.04], np.float32))
+    out = allreduce_arrays([x], compression="2bit", compressor=gc)[0]
+    # first step: nothing exceeds the threshold yet
+    np.testing.assert_allclose(np.asarray(out), np.zeros(3), atol=1e-6)
+    # repeated calls drain the residual toward the true sum
+    total = np.asarray(out)
+    for _ in range(19):
+        total = total + np.asarray(
+            allreduce_arrays([x], compression="2bit", compressor=gc)[0])
+    np.testing.assert_allclose(total, 20 * np.asarray(x), atol=0.5)
+
+
+def test_kvstore_2bit_api():
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.25})
+    assert kv._compression == "2bit"
+    assert kv._compressor.threshold == 0.25
+    kv.set_gradient_compression({"type": "none"})
+    assert kv._compression is None
+
+
+def test_compressed_training_converges():
+    """Toy linear regression where every gradient goes through 2-bit
+    compression + error feedback: loss must still converge (VERDICT r4
+    item 8 'done' criterion)."""
+    rs = np.random.RandomState(1)
+    w_true = rs.randn(8).astype(np.float32)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = X @ w_true
+
+    gc = GradientCompression(threshold=0.5)
+    w = np.zeros(8, np.float32)
+    lr = 0.05
+    losses = []
+    for step in range(800):
+        pred = X @ w
+        losses.append(float(np.mean((pred - y) ** 2)))
+        grad = 2 * X.T @ (pred - y) / len(y)
+        packed = gc.compress("w", jnp.asarray(grad))
+        gq = np.asarray(gc.decompress(packed, (8,)))
+        w = w - lr * gq
+    assert losses[-1] < losses[0] * 0.01, (losses[0], losses[-1])
+    np.testing.assert_allclose(w, w_true, atol=0.1)

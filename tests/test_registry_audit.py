@@ -1,0 +1,168 @@
+"""Round-4 registry-audit wave (VERDICT item 9): legacy aliases, the
+optimizer-variant family, random_pdf_* ops, and easy contrib ops, checked
+against numpy/scipy-formula oracles."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import ndarray as nd
+
+
+def test_legacy_aliases_resolve():
+    from incubator_mxnet_tpu.ops.registry import get
+
+    for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1",
+                 "ElementWiseSum", "Softmax", "broadcast_axes",
+                 "broadcast_minus", "broadcast_plus", "crop", "max_axis",
+                 "min_axis", "sum_axis", "make_loss", "SparseEmbedding"):
+        assert get(name) is not None, name
+
+
+def test_make_loss_gradient_is_grad_scale():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.make_loss(x * 2.0)
+    loss.backward(nd.array(np.array([9.0, 9.0, 9.0], np.float32)))
+    # backward through make_loss emits 1.0 regardless of the head grad
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_elementwise_sum_alias():
+    a = nd.array(np.ones(4, np.float32))
+    out = nd.ElementWiseSum(a, a, a)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 3.0))
+
+
+def test_random_pdf_normal_matches_formula():
+    rs = np.random.RandomState(0)
+    s = rs.randn(8).astype(np.float32)
+    mu = np.zeros(8, np.float32)
+    sigma = np.full(8, 1.5, np.float32)
+    got = nd.random_pdf_normal(nd.array(s), nd.array(mu),
+                               nd.array(sigma)).asnumpy()
+    ref = np.exp(-0.5 * (s / 1.5) ** 2) / (1.5 * np.sqrt(2 * np.pi))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_random_pdf_poisson_sums_near_one():
+    lam = np.full(1, 3.0, np.float32)
+    ks = np.arange(40, dtype=np.float32)
+    total = sum(float(nd.random_pdf_poisson(
+        nd.array(np.array([k])), nd.array(lam)).asscalar()) for k in ks)
+    assert abs(total - 1.0) < 1e-4
+
+
+def test_random_pdf_gamma_matches_formula():
+    s = np.array([0.5, 1.0, 2.5], np.float32)
+    alpha = np.full(3, 2.0, np.float32)
+    beta = np.full(3, 1.5, np.float32)
+    got = nd.random_pdf_gamma(nd.array(s), nd.array(alpha),
+                              nd.array(beta)).asnumpy()
+    from math import gamma as _g
+
+    ref = (beta ** alpha) * s ** (alpha - 1) * np.exp(-beta * s) / _g(2.0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_negative_binomial_sampler_moments():
+    mx.random.seed(7)
+    k, p = 4.0, 0.4
+    out = nd.invoke_op("random_negative_binomial", k=k, p=p,
+                       shape=(20000,)).asnumpy()
+    # mean k(1-p)/p, var k(1-p)/p^2
+    assert abs(out.mean() - k * (1 - p) / p) < 0.3
+    assert abs(out.var() - k * (1 - p) / p ** 2) < 1.5
+
+
+def test_ftml_update_decreases_loss():
+    w = nd.array(np.array([5.0], np.float32))
+    d = nd.array(np.zeros(1, np.float32))
+    v = nd.array(np.zeros(1, np.float32))
+    z = nd.array(np.zeros(1, np.float32))
+    for t in range(1, 200):
+        g = 2 * w  # d/dw w^2
+        w, d, v, z = [nd.NDArray(a._data) for a in nd.invoke_op(
+            "ftml_update", w, g, d, v, z, lr=0.3, t=t)]
+    assert abs(float(w.asscalar())) < 0.5
+
+
+def test_multi_lars_and_sum_sq():
+    ws = [nd.array(np.full((4,), 2.0, np.float32)),
+          nd.array(np.full((2,), 3.0, np.float32))]
+    gs = [nd.array(np.full((4,), 1.0, np.float32)),
+          nd.array(np.full((2,), 0.0, np.float32))]
+    wss = nd.multi_sum_sq(*ws)
+    gss = nd.multi_sum_sq(*gs)
+    np.testing.assert_allclose(wss.asnumpy(), [16.0, 18.0])
+    lrs = nd.invoke_op("multi_lars", nd.array(np.ones(2, np.float32)),
+                       wss, gss, nd.array(np.zeros(2, np.float32)),
+                       eta=1.0, eps=0.0)
+    got = lrs.asnumpy()
+    np.testing.assert_allclose(got[0], 4.0 / 2.0, rtol=1e-5)
+    np.testing.assert_allclose(got[1], 1.0)   # zero grad -> unscaled
+
+
+def test_preloaded_multi_sgd():
+    w = nd.array(np.full((3,), 1.0, np.float32))
+    g = nd.array(np.full((3,), 0.5, np.float32))
+    lrs = nd.array(np.array([0.1], np.float32))
+    wds = nd.array(np.array([0.0], np.float32))
+    out, = nd.invoke_op("preloaded_multi_sgd_update", w, g, lrs, wds,
+                        num_weights=1)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 0.95), rtol=1e-6)
+
+
+def test_reset_arrays():
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.ones((3,), np.float32))
+    za, zb = nd.reset_arrays(a, b)
+    assert not za.asnumpy().any() and not zb.asnumpy().any()
+    # reference semantics: the INPUTS are zeroed in place (the op is
+    # called for its side effect; return value usually discarded)
+    assert not a.asnumpy().any() and not b.asnumpy().any()
+
+
+def test_adaptive_avg_pooling2d():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=2).asnumpy()
+    ref = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32)
+    np.testing.assert_allclose(out, ref)
+    # non-divisible output size uses floor/ceil ranges
+    out3 = nd.contrib.AdaptiveAvgPooling2D(x, output_size=3)
+    assert out3.shape == (1, 1, 3, 3)
+
+
+def test_batch_norm_with_relu():
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.randn(2, 3, 4, 4).astype(np.float32))
+    g = nd.array(np.ones(3, np.float32))
+    b = nd.array(np.zeros(3, np.float32))
+    m = nd.array(np.zeros(3, np.float32))
+    v = nd.array(np.ones(3, np.float32))
+    out = nd.contrib.BatchNormWithReLU(x, g, b, m, v)
+    assert (out.asnumpy() >= 0).all()
+    ref = np.maximum(x.asnumpy() / np.sqrt(1 + 1e-5), 0)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_requantize_int32_to_int8():
+    data = nd.array(np.array([2 ** 30, -2 ** 29, 0], np.int32),
+                    dtype="int32")
+    q, lo, hi = nd.contrib.requantize(
+        data, nd.array(np.array([-1.0], np.float32)),
+        nd.array(np.array([1.0], np.float32)))
+    vals = q.asnumpy().astype(np.float32) * float(hi.asscalar()) / 127.0
+    ref = np.array([2 ** 30, -2 ** 29, 0], np.float64) / 2147483647.0
+    np.testing.assert_allclose(vals, ref, atol=0.01)
+
+
+def test_gradientmultiplier_scales_backward():
+    x = nd.array(np.array([1.0, -2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x * 3.0, scalar=-0.5)
+    y.backward(nd.array(np.ones(2, np.float32)))
+    np.testing.assert_allclose(x.grad.asnumpy(), [-1.5, -1.5])

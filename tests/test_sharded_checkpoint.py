@@ -106,3 +106,53 @@ def test_mxtpu001_format_backward_compat():
     np.testing.assert_allclose(loaded["bias"].asnumpy(),
                                np.array([-1.5, 2.25], np.float32),
                                rtol=0, atol=0)
+
+
+def test_mxtpu004_gluon_params_backward_compat():
+    """Second pinned artifact (round 4): gluon save_parameters format
+    (structured names) must keep loading bit-exactly."""
+    here = os.path.join(os.path.dirname(__file__), "compat",
+                        "pinned_mxtpu004_gluon.params")
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, in_units=2), nn.Dense(2, in_units=3))
+    net.initialize(init="zeros")
+    net.load_parameters(here)
+    ps = list(net.collect_params().values())
+    np.testing.assert_array_equal(
+        ps[0].data().asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(3, 2) / 3.0)
+    np.testing.assert_array_equal(
+        ps[1].data().asnumpy(), np.array([0.5, -0.5, 1.5], np.float32))
+    np.testing.assert_array_equal(
+        ps[2].data().asnumpy(),
+        np.arange(6, dtype=np.float32).reshape(2, 3) * -0.25)
+    np.testing.assert_array_equal(
+        ps[3].data().asnumpy(), np.array([2.0, -3.0], np.float32))
+
+
+def test_mxtpu004_sharded_checkpoint_backward_compat():
+    """Third pinned artifact (round 4): the sharded mesh-checkpoint format
+    (manifest + per-host .npz shards, TP-sharded weight) must restore
+    bit-exactly into a fresh trainer."""
+    from incubator_mxnet_tpu.gluon import nn
+    from jax.sharding import PartitionSpec as P
+
+    prefix = os.path.join(os.path.dirname(__file__), "compat",
+                          "pinned_mxtpu004_sharded")
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.initialize(init="zeros")
+    parallel.shard_params(net, {r".*weight": P("model", None)})
+    tr = parallel.SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 0.1}, mesh=mesh)
+    parallel.restore_sharded(prefix, tr)
+    names = sorted(tr.params)
+    w = np.asarray(tr.params[[n for n in names if "weight" in n][0]])
+    b = np.asarray(tr.params[[n for n in names if "bias" in n][0]])
+    np.testing.assert_array_equal(
+        w, (np.arange(32, dtype=np.float32).reshape(8, 4) - 16.0) / 8.0)
+    np.testing.assert_array_equal(
+        b, np.linspace(-1, 1, 8).astype(np.float32))
