@@ -290,13 +290,14 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if impl == "pallas":
         # full-sequence flash kernel per head-group (each device holds the
-        # whole sequence after the head-scatter)
-        from ..ops.pallas_attention import _flash_fwd
+        # whole sequence after the head-scatter); _flash_core carries the
+        # streaming Pallas backward, so this path is differentiable
+        from ..ops.pallas_attention import _flash_core
 
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
-        of = _flash_fwd(qf, kf, vf, None, 1.0 / float(np.sqrt(d)),
-                        causal, interpret)
+        of = _flash_core(qf, kf, vf, None, 1.0 / float(np.sqrt(d)),
+                         bool(causal), bool(interpret))
         return gather_heads(of)
     scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale

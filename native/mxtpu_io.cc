@@ -261,3 +261,288 @@ int mxio_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native im2rec packer (reference tools/im2rec.cc): .lst -> .rec/.idx with
+// parallel decode/resize/re-encode and ordered sequential writing.
+// ---------------------------------------------------------------------------
+namespace {
+
+// Encode RGB (h, w) to JPEG at `quality`. Returns 0 on success.
+int EncodeJpeg(const uint8_t* rgb, int h, int w, int quality,
+               std::vector<uint8_t>* out) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  unsigned char* buf = nullptr;
+  unsigned long buflen = 0;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_compress(&cinfo);
+    if (buf) free(buf);
+    return 1;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &buf, &buflen);
+  cinfo.image_width = static_cast<JDIMENSION>(w);
+  cinfo.image_height = static_cast<JDIMENSION>(h);
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  std::vector<uint8_t> row(static_cast<size_t>(w) * 3);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    std::memcpy(row.data(),
+                rgb + static_cast<size_t>(cinfo.next_scanline) * w * 3,
+                static_cast<size_t>(w) * 3);
+    JSAMPROW rows[1] = {row.data()};
+    jpeg_write_scanlines(&cinfo, rows, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  out->assign(buf, buf + buflen);
+  jpeg_destroy_compress(&cinfo);
+  free(buf);
+  return 0;
+}
+
+// Bilinear RGB resize.
+void ResizeBilinear(const uint8_t* src, int h, int w, uint8_t* dst,
+                    int oh, int ow) {
+  const float sy = static_cast<float>(h) / oh;
+  const float sx = static_cast<float>(w) / ow;
+  for (int y = 0; y < oh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < ow; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(static_cast<size_t>(y0) * w + x0) * 3 + c];
+        float v01 = src[(static_cast<size_t>(y0) * w + x1) * 3 + c];
+        float v10 = src[(static_cast<size_t>(y1) * w + x0) * 3 + c];
+        float v11 = src[(static_cast<size_t>(y1) * w + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<size_t>(y) * ow + x) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct LstItem {
+  uint64_t id = 0;
+  float label = 0.f;
+  std::string path;
+};
+
+bool ReadFileBytes(const std::string& path, std::vector<uint8_t>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(n > 0 ? static_cast<size_t>(n) : 0);
+  bool ok = n <= 0 || std::fread(out->data(), 1, out->size(), f) ==
+                          out->size();
+  std::fclose(f);
+  return ok;
+}
+
+bool IsJpegName(const std::string& p) {
+  auto dot = p.rfind('.');
+  if (dot == std::string::npos) return false;
+  std::string ext = p.substr(dot);
+  for (auto& c : ext) c = static_cast<char>(std::tolower(c));
+  return ext == ".jpg" || ext == ".jpeg";
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack PREFIX.lst into .rec/.idx (IndexedRecordIO; IRHeader = <I flag,
+// f label, Q id, Q id2> + payload). resize > 0: jpegs whose shorter side
+// exceeds it are bilinear-resized (shorter side -> resize) and
+// re-encoded at `quality`; other files pass through untouched. Parallel
+// workers, strictly ordered writer. Returns number of records written,
+// or -1 on IO error.
+long mxio_im2rec(const char* lst_path, const char* root,
+                 const char* rec_path, const char* idx_path, int resize,
+                 int quality, int threads) {
+  std::vector<LstItem> items;
+  {
+    FILE* f = std::fopen(lst_path, "r");
+    if (!f) return -1;
+    char line[4096];
+    while (std::fgets(line, sizeof line, f)) {
+      LstItem it;
+      char pathbuf[3584];
+      // lst line: index \t label \t relpath
+      if (std::sscanf(line, "%lu\t%f\t%3583[^\t\n]", &it.id, &it.label,
+                      pathbuf) == 3) {
+        it.path = std::string(root) + "/" + pathbuf;
+        items.push_back(std::move(it));
+      }
+    }
+    std::fclose(f);
+  }
+  const int n = static_cast<int>(items.size());
+  std::vector<std::vector<uint8_t>> payloads(n);
+  std::vector<std::atomic<int>> ready(n);
+  for (auto& r : ready) r.store(0);
+  std::mutex mu;
+  std::condition_variable cv;       // writer <- "item ready"
+  std::condition_variable cv_room;  // workers <- "writer advanced"
+  std::atomic<int> next{0};
+  std::atomic<int> written_pos{0};
+
+  auto work = [&] {
+    int i;
+    while ((i = next.fetch_add(1)) < n) {
+      {
+        // backpressure: keep at most `window` undrained payloads in RAM
+        // (one slow early item must not let 1M later ones accumulate;
+        // the reference's native packer bounds this with a fixed queue)
+        const int window = 64 + 8 * 16;
+        std::unique_lock<std::mutex> lk(mu);
+        cv_room.wait(lk, [&] { return i < written_pos.load() + window; });
+      }
+      std::vector<uint8_t> bytes;
+      bool ok = ReadFileBytes(items[i].path, &bytes);
+      std::vector<uint8_t> img = std::move(bytes);
+      if (ok && resize > 0 && IsJpegName(items[i].path)) {
+        int h = 0, w = 0;
+        jpeg_decompress_struct ci;
+        JpegErr je;
+        ci.err = jpeg_std_error(&je.pub);
+        je.pub.error_exit = JpegErrExit;
+        if (!setjmp(je.jmp)) {
+          jpeg_create_decompress(&ci);
+          jpeg_mem_src(&ci, img.data(),
+                       static_cast<unsigned long>(img.size()));
+          jpeg_read_header(&ci, TRUE);
+          h = static_cast<int>(ci.image_height);
+          w = static_cast<int>(ci.image_width);
+          jpeg_destroy_decompress(&ci);
+        } else {
+          jpeg_destroy_decompress(&ci);
+          h = w = 0;
+        }
+        int shorter = h < w ? h : w;
+        if (h > 0 && shorter != resize) {
+          std::vector<uint8_t> rgb(static_cast<size_t>(h) * w * 3);
+          int gh = 0, gw = 0;
+          if (DecodeJpeg(img.data(), img.size(), rgb.data(), h, w, &gh,
+                         &gw) == 0) {
+            int oh = h, ow = w;
+            if (h <= w) {
+              oh = resize;
+              ow = static_cast<int>(
+                  static_cast<long>(w) * resize / h);
+            } else {
+              ow = resize;
+              oh = static_cast<int>(
+                  static_cast<long>(h) * resize / w);
+            }
+            std::vector<uint8_t> small(static_cast<size_t>(oh) * ow * 3);
+            ResizeBilinear(rgb.data(), gh, gw, small.data(), oh, ow);
+            std::vector<uint8_t> enc;
+            if (EncodeJpeg(small.data(), oh, ow, quality, &enc) == 0) {
+              img = std::move(enc);
+            }
+          }
+        }
+      }
+      // IRHeader(flag=0, label, id, id2=0) + payload
+      std::vector<uint8_t>& rec = payloads[i];
+      rec.resize(24 + img.size());
+      uint32_t flag = 0;
+      float label = items[i].label;
+      uint64_t id = items[i].id, id2 = 0;
+      std::memcpy(rec.data(), &flag, 4);
+      std::memcpy(rec.data() + 4, &label, 4);
+      std::memcpy(rec.data() + 8, &id, 8);
+      std::memcpy(rec.data() + 16, &id2, 8);
+      if (!img.empty())
+        std::memcpy(rec.data() + 24, img.data(), img.size());
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready[i].store(ok ? 1 : 2);
+        cv.notify_all();
+      }
+    }
+  };
+
+  if (threads < 1) threads = 1;
+  std::vector<std::thread> pool;
+  for (int t = 0; t < threads; ++t) pool.emplace_back(work);
+
+  FILE* rec_f = std::fopen(rec_path, "wb");
+  FILE* idx_f = std::fopen(idx_path, "w");
+  long written = 0;
+  bool io_ok = rec_f && idx_f;
+  for (int i = 0; i < n && io_ok; ++i) {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] { return ready[i].load() != 0; });
+    }
+    if (ready[i].load() == 2) continue;  // unreadable file: skip
+    const auto& rec = payloads[i];
+    if (rec.size() >= (1u << 29)) {
+      // RecordIO length field is 29 bits (upper 3 = continuation flags,
+      // which this writer does not emit) — skip with a loud warning
+      std::fprintf(stderr,
+                   "mxio_im2rec: record %d (%zu bytes) exceeds the "
+                   "RecordIO 2^29-byte single-record limit; skipped\n",
+                   i, rec.size());
+      payloads[i].clear();
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        written_pos.store(i + 1);
+        cv_room.notify_all();
+      }
+      continue;
+    }
+    long offset = std::ftell(rec_f);
+    uint32_t magic = kMagic;
+    uint32_t lrec = static_cast<uint32_t>(rec.size());
+    io_ok = std::fwrite(&magic, 4, 1, rec_f) == 1 &&
+            std::fwrite(&lrec, 4, 1, rec_f) == 1 &&
+            (rec.empty() ||
+             std::fwrite(rec.data(), 1, rec.size(), rec_f) == rec.size());
+    size_t pad = (4 - (rec.size() & 3)) & 3;
+    static const uint8_t zeros[4] = {0, 0, 0, 0};
+    if (io_ok && pad) io_ok = std::fwrite(zeros, 1, pad, rec_f) == pad;
+    if (io_ok) {
+      std::fprintf(idx_f, "%lu\t%ld\n", items[i].id, offset);
+      ++written;
+    }
+    payloads[i].clear();
+    payloads[i].shrink_to_fit();
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      written_pos.store(i + 1);
+      cv_room.notify_all();
+    }
+  }
+  {
+    // unblock any workers still waiting if the writer bailed early
+    std::lock_guard<std::mutex> lk(mu);
+    written_pos.store(n);
+    cv_room.notify_all();
+  }
+  for (auto& th : pool) th.join();
+  if (rec_f) std::fclose(rec_f);
+  if (idx_f) std::fclose(idx_f);
+  return io_ok ? written : -1;
+}
+
+}  // extern "C"

@@ -58,7 +58,11 @@ def test_int64_element_count_boundary():
     x = nd.zeros((n,), dtype="int8")
     assert x.size == n
     y = x + 1
-    assert float(y.sum().asscalar()) == float(n)
+    # int8 reductions promote to int32 (x32 mode), which WRAPS past 2^31
+    # elements — reduce in f32 (f32 holds n exactly up to 2^53... this n
+    # rounds to a representable value; compare against the same rounding)
+    got = float(y.astype("float32").sum().asscalar())
+    assert abs(got - float(n)) <= 4096, (got, n)   # f32 ulp at 2^31 = 256
     assert y.reshape((2, n // 2)).shape == (2, n // 2)
 
 

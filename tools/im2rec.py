@@ -59,6 +59,43 @@ def make_list(prefix: str, root: str, shuffle: bool, train_ratio: float,
         print(f"wrote {prefix}.lst ({len(entries)} entries)")
 
 
+def pack_records_native(prefix: str, root: str, quality: int,
+                        resize: int, num_thread: int) -> bool:
+    """Pack via the C++ packer (reference tools/im2rec.cc analog:
+    parallel decode/resize/re-encode, ordered writer). Returns False if
+    the native library is unavailable (caller falls back to python)."""
+    import ctypes
+
+    from incubator_mxnet_tpu import native
+
+    lib = native.lib()
+    if lib is None or not hasattr(lib, "mxio_im2rec"):
+        return False
+    lst = f"{prefix}.lst"
+    if not os.path.exists(lst):
+        raise SystemExit(f"{lst} not found; generate it with --list first")
+    if resize > 0:
+        # the native packer resizes/re-encodes JPEGs only; mixed datasets
+        # (png/bmp) must go through the python packer so --resize means
+        # the same thing regardless of which packer ran
+        with open(lst) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) >= 3 and not parts[2].lower().endswith(
+                        (".jpg", ".jpeg")):
+                    return False
+    lib.mxio_im2rec.restype = ctypes.c_long
+    lib.mxio_im2rec.argtypes = [ctypes.c_char_p] * 4 + [ctypes.c_int] * 3
+    n = lib.mxio_im2rec(lst.encode(), root.encode(),
+                        f"{prefix}.rec".encode(), f"{prefix}.idx".encode(),
+                        int(resize), int(quality), int(num_thread))
+    if n < 0:
+        raise SystemExit("native im2rec failed (IO error)")
+    print(f"packed {n} records into {prefix}.rec (+ {prefix}.idx) "
+          f"[native, {num_thread} threads]")
+    return True
+
+
 def pack_records(prefix: str, root: str, quality: int, resize: int) -> None:
     import numpy as np
     from PIL import Image
@@ -102,12 +139,20 @@ def main(argv=None):
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--resize", type=int, default=0,
                     help="resize shorter side to this many pixels")
+    ap.add_argument("--num-thread", type=int, default=4,
+                    help="native packer worker threads")
+    ap.add_argument("--no-native", action="store_true",
+                    help="force the pure-python packer")
     args = ap.parse_args(argv)
     if args.list:
         make_list(args.prefix, args.root, bool(args.shuffle),
                   args.train_ratio)
     else:
-        pack_records(args.prefix, args.root, args.quality, args.resize)
+        if args.no_native or not pack_records_native(
+                args.prefix, args.root, args.quality, args.resize,
+                args.num_thread):
+            pack_records(args.prefix, args.root, args.quality,
+                         args.resize)
 
 
 if __name__ == "__main__":
