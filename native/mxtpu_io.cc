@@ -275,7 +275,9 @@ int EncodeJpeg(const uint8_t* rgb, int h, int w, int quality,
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = JpegErrExit;
-  unsigned char* buf = nullptr;
+  // volatile: modified between setjmp and a potential longjmp (C11
+  // 7.13.2.1 — non-volatile locals are indeterminate after longjmp)
+  unsigned char* volatile buf = nullptr;
   unsigned long buflen = 0;
   if (setjmp(jerr.jmp)) {
     jpeg_destroy_compress(&cinfo);
@@ -283,7 +285,11 @@ int EncodeJpeg(const uint8_t* rgb, int h, int w, int quality,
     return 1;
   }
   jpeg_create_compress(&cinfo);
-  jpeg_mem_dest(&cinfo, &buf, &buflen);
+  {
+    unsigned char* tmp = buf;
+    jpeg_mem_dest(&cinfo, &tmp, &buflen);
+    buf = tmp;
+  }
   cinfo.image_width = static_cast<JDIMENSION>(w);
   cinfo.image_height = static_cast<JDIMENSION>(h);
   cinfo.input_components = 3;
@@ -442,16 +448,15 @@ long mxio_im2rec(const char* lst_path, const char* root,
           int gh = 0, gw = 0;
           if (DecodeJpeg(img.data(), img.size(), rgb.data(), h, w, &gh,
                          &gw) == 0) {
-            int oh = h, ow = w;
-            if (h <= w) {
-              oh = resize;
-              ow = static_cast<int>(
-                  static_cast<long>(w) * resize / h);
-            } else {
-              ow = resize;
-              oh = static_cast<int>(
-                  static_cast<long>(h) * resize / w);
-            }
+            // EXACTLY the python packer's arithmetic (scale as a
+            // double, truncate): integer w*resize/h differs by one
+            // pixel for many aspect ratios and breaks drop-in parity
+            double scale = static_cast<double>(resize) / shorter;
+            int ow = w, oh = h;
+            ow = static_cast<int>(w * scale);
+            oh = static_cast<int>(h * scale);
+            if (ow < 1) ow = 1;
+            if (oh < 1) oh = 1;
             std::vector<uint8_t> small(static_cast<size_t>(oh) * ow * 3);
             ResizeBilinear(rgb.data(), gh, gw, small.data(), oh, ow);
             std::vector<uint8_t> enc;
@@ -494,7 +499,12 @@ long mxio_im2rec(const char* lst_path, const char* root,
       std::unique_lock<std::mutex> lk(mu);
       cv.wait(lk, [&] { return ready[i].load() != 0; });
     }
-    if (ready[i].load() == 2) continue;  // unreadable file: skip
+    if (ready[i].load() == 2) {          // unreadable file: skip
+      std::lock_guard<std::mutex> lk(mu);
+      written_pos.store(i + 1);
+      cv_room.notify_all();
+      continue;
+    }
     const auto& rec = payloads[i];
     if (rec.size() >= (1u << 29)) {
       // RecordIO length field is 29 bits (upper 3 = continuation flags,

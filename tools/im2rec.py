@@ -74,16 +74,16 @@ def pack_records_native(prefix: str, root: str, quality: int,
     lst = f"{prefix}.lst"
     if not os.path.exists(lst):
         raise SystemExit(f"{lst} not found; generate it with --list first")
-    if resize > 0:
-        # the native packer resizes/re-encodes JPEGs only; mixed datasets
-        # (png/bmp) must go through the python packer so --resize means
-        # the same thing regardless of which packer ran
-        with open(lst) as f:
-            for line in f:
-                parts = line.strip().split("\t")
-                if len(parts) >= 3 and not parts[2].lower().endswith(
-                        (".jpg", ".jpeg")):
-                    return False
+    # the native packer handles JPEG payloads only (pass-through for
+    # anything else), while the python packer re-encodes EVERY image to
+    # jpeg at --quality; mixed datasets must go through the python packer
+    # so the CLI means the same thing regardless of which packer ran
+    with open(lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 3 and not parts[2].lower().endswith(
+                    (".jpg", ".jpeg")):
+                return False
     lib.mxio_im2rec.restype = ctypes.c_long
     lib.mxio_im2rec.argtypes = [ctypes.c_char_p] * 4 + [ctypes.c_int] * 3
     n = lib.mxio_im2rec(lst.encode(), root.encode(),
