@@ -646,3 +646,10 @@ contrib.AdaptiveAvgPooling2D = _wrap("AdaptiveAvgPooling2D", 1)
 contrib.BatchNormWithReLU = _wrap("BatchNormWithReLU", 5)
 contrib.requantize = _wrap("requantize", 3)
 contrib.SparseEmbedding = _this.Embedding
+
+# RPN proposal + PS/rotated ROI pooling family (round 4)
+contrib.Proposal = _wrap("Proposal", 3)
+contrib.MultiProposal = _wrap("MultiProposal", 3)
+contrib.PSROIPooling = _wrap("PSROIPooling", 2)
+contrib.DeformablePSROIPooling = _wrap("DeformablePSROIPooling", 3)
+contrib.RROIAlign = _wrap("RROIAlign", 2)

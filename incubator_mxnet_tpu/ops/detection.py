@@ -429,3 +429,297 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                        out.dtype)
         out = jnp.concatenate([out, pad], axis=1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# RPN proposal family (round 4: reference src/operator/contrib/proposal.cc
+# / multi_proposal.cc — previously a documented deliberate skip)
+# ---------------------------------------------------------------------------
+def _generate_base_anchors(stride, scales, ratios):
+    """Reference rcnn generate_anchors: base box [0, 0, stride-1,
+    stride-1], ratio enumeration (rounded), then scale enumeration."""
+    import numpy as np
+
+    base = float(stride)
+    w = h = base
+    cx = cy = (base - 1.0) / 2.0
+    size = w * h
+    anchors = []
+    for r in ratios:
+        size_r = size / r
+        ws = round(np.sqrt(size_r))
+        hs = round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - (wss - 1) / 2.0, cy - (hss - 1) / 2.0,
+                            cx + (wss - 1) / 2.0, cy + (hss - 1) / 2.0])
+    return jnp.asarray(np.array(anchors, np.float32))       # (A, 4)
+
+
+def _bbox_transform_inv(boxes, deltas):
+    """Fast R-CNN delta decode: (dx, dy, dw, dh) on corner boxes."""
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (ws - 1.0)
+    cy = boxes[:, 1] + 0.5 * (hs - 1.0)
+    dx, dy, dw, dh = (deltas[:, 0], deltas[:, 1], deltas[:, 2],
+                      deltas[:, 3])
+    pcx = dx * ws + cx
+    pcy = dy * hs + cy
+    pw = jnp.exp(dw) * ws
+    ph = jnp.exp(dh) * hs
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                     axis=1)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, stride, pre_nms,
+                  post_nms, thresh, min_size):
+    """One image: scores (A, H, W) fg, deltas (4A, H, W), im_info (3,).
+    Returns (post_nms, 4) corner rois + (post_nms,) scores (padded with
+    zeros when fewer survive — static-shape divergence from the
+    reference's repeat-padding, documented)."""
+    a, h, w = scores.shape
+    shift_x = jnp.arange(w, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(h, dtype=jnp.float32) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)                 # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)           # (H, W, 4)
+    all_anchors = (anchors[None, None] + shifts[:, :, None]
+                   ).reshape(-1, 4)                          # (HWA, 4)
+    all_deltas = deltas.reshape(a, 4, h, w).transpose(2, 3, 0, 1
+                                                     ).reshape(-1, 4)
+    all_scores = scores.transpose(1, 2, 0).reshape(-1)
+
+    boxes = _bbox_transform_inv(all_anchors, all_deltas)
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0, im_info[1] - 1.0),
+        jnp.clip(boxes[:, 1], 0, im_info[0] - 1.0),
+        jnp.clip(boxes[:, 2], 0, im_info[1] - 1.0),
+        jnp.clip(boxes[:, 3], 0, im_info[0] - 1.0)], axis=1)
+    ms = min_size * im_info[2]
+    keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1.0 >= ms)
+               & (boxes[:, 3] - boxes[:, 1] + 1.0 >= ms))
+    masked = jnp.where(keep_sz, all_scores, -jnp.inf)
+
+    k = min(pre_nms, boxes.shape[0])
+    top_scores, order = lax.top_k(masked, k)
+    top_boxes = boxes[order]
+    valid = jnp.isfinite(top_scores)
+    keep, nms_order = _nms_one(top_boxes, top_scores,
+                               jnp.zeros_like(top_scores), thresh, valid,
+                               True)
+    # kept boxes in score order, compacted to the front
+    sorted_boxes = top_boxes[nms_order]
+    sorted_scores = top_scores[nms_order]
+    rank = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, k)
+    in_range = keep & (rank < post_nms)
+    idx = jnp.where(in_range, rank, post_nms)               # dump slot
+    out_boxes = jnp.zeros((post_nms + 1, 4), boxes.dtype
+                          ).at[idx].set(sorted_boxes)[:post_nms]
+    out_scores = jnp.zeros((post_nms + 1,), all_scores.dtype
+                           ).at[idx].set(
+        jnp.where(jnp.isfinite(sorted_scores), sorted_scores, 0.0)
+    )[:post_nms]
+    return out_boxes, out_scores
+
+
+@register("Proposal", aliases=("proposal", "contrib_Proposal"),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, feature_stride=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+             threshold=0.7, rpn_min_size=16, output_score=False):
+    """RPN proposal generation (reference contrib Proposal): decode
+    anchor deltas, clip, min-size filter, top-pre_nms, NMS, top-post_nms.
+    cls_prob (N, 2A, H, W), bbox_pred (N, 4A, H, W), im_info (N, 3)
+    [height, width, scale]. Output rois (N*post_nms, 5) with batch index
+    in column 0 (+ scores (N*post_nms, 1) when output_score)."""
+    n, a2, h, w = cls_prob.shape
+    a = a2 // 2
+    anchors = _generate_base_anchors(feature_stride, scales, ratios)
+    fg = cls_prob[:, a:, :, :]
+
+    def one(scores_i, deltas_i, info_i):
+        return _proposal_one(scores_i, deltas_i, info_i, anchors,
+                             float(feature_stride),
+                             int(rpn_pre_nms_top_n),
+                             int(rpn_post_nms_top_n), float(threshold),
+                             float(rpn_min_size))
+
+    boxes, scores = jax.vmap(one)(fg, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(n, dtype=boxes.dtype),
+                      int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([bidx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+@register("MultiProposal", aliases=("multi_proposal",
+                                    "contrib_MultiProposal"),
+          differentiable=False)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batch RPN proposals (reference contrib MultiProposal — same math
+    as Proposal, explicitly batched; ours is vmapped already)."""
+    return proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive / rotated ROI pooling family (round 4: reference
+# src/operator/contrib/psroi_pooling.cc, deformable_psroi_pooling.cc,
+# rroi_align.cc — previously documented deliberate skips)
+# ---------------------------------------------------------------------------
+@register("PSROIPooling", aliases=("psroi_pooling", "contrib_PSROIPooling"))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                  pooled_size=1, group_size=0):
+    """Position-sensitive ROI pooling (R-FCN): output bin (i, j) averages
+    channel block ``d*g*g + i*g + j`` over the bin's spatial extent.
+    data (N, output_dim*g*g, H, W); rois (R, 5); out (R, output_dim,
+    p, p)."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    n, c, h, w = data.shape
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        i = jnp.arange(p, dtype=jnp.float32)
+        hstart = jnp.floor(y1 + i * bh)
+        hend = jnp.ceil(y1 + (i + 1) * bh)
+        wstart = jnp.floor(x1 + i * bw)
+        wend = jnp.ceil(x1 + (i + 1) * bw)
+        my = ((ys[None, :] >= jnp.clip(hstart, 0, h)[:, None])
+              & (ys[None, :] < jnp.clip(hend, 0, h)[:, None])
+              ).astype(data.dtype)                   # (p, H)
+        mx = ((xs[None, :] >= jnp.clip(wstart, 0, w)[:, None])
+              & (xs[None, :] < jnp.clip(wend, 0, w)[:, None])
+              ).astype(data.dtype)                   # (p, W)
+        img = data[b].reshape(output_dim, g, g, h, w)
+        # bin (i, j) uses group cell (i*g//p, j*g//p) (g == p typically)
+        gi = (i.astype(jnp.int32) * g) // p
+        img_sel = img[:, gi][:, :, gi]               # (D, p, p, H, W)
+        num = jnp.einsum("dijhw,ih,jw->dij", img_sel, my, mx)
+        cnt = jnp.maximum(my.sum(1)[:, None] * mx.sum(1)[None, :], 1.0)
+        return num / cnt
+
+    return jax.vmap(one)(rois)
+
+
+@register("DeformablePSROIPooling",
+          aliases=("deformable_psroi_pooling",
+                   "contrib_DeformablePSROIPooling"))
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=1, pooled_size=1, group_size=0,
+                             part_size=0, sample_per_part=4,
+                             trans_std=0.1, no_trans=False):
+    """Deformable PSROI pooling (Deformable ConvNets): PSROI bins shifted
+    by learned normalized offsets ``trans`` (R, 2, p, p) * trans_std *
+    roi size, averaged over ``sample_per_part``^2 bilinear samples."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    if part_size not in (0, p):
+        raise NotImplementedError(
+            f"part_size={part_size} != pooled_size={p}: the part-cell "
+            "lookup is not implemented; pass part_size=0 (trans shaped "
+            "(R, 2, pooled_size, pooled_size))")
+    sp = max(1, int(sample_per_part))
+    n, c, h, w = data.shape
+
+    def one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        img = data[b].reshape(output_dim, g, g, h, w)
+
+        i = jnp.arange(p, dtype=jnp.float32)
+        dx = tr[0] * trans_std * rw                  # (p, p)
+        dy = tr[1] * trans_std * rh
+        # sample grid per bin: (p_i, p_j, sp_y, sp_x) coords
+        s = (jnp.arange(sp, dtype=jnp.float32) + 0.5) / sp
+        by = y1 + i * bh                             # (p,)
+        bx = x1 + i * bw
+        yy = by[:, None, None, None] + (s * bh)[None, None, :, None] \
+            + dy[:, :, None, None]                   # (p, p, sp, 1)
+        xx = bx[None, :, None, None] + (s * bw)[None, None, None, :] \
+            + dx[:, :, None, None]                   # (p, p, 1, sp)
+        yy = jnp.broadcast_to(yy, (p, p, sp, sp)).reshape(-1)
+        xx = jnp.broadcast_to(xx, (p, p, sp, sp)).reshape(-1)
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        wy = jnp.clip(yy - y0, 0.0, 1.0).astype(data.dtype)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wx = jnp.clip(xx - x0, 0.0, 1.0).astype(data.dtype)
+
+        gi = (jnp.arange(p) * g) // p
+        img_sel = img[:, gi][:, :, gi]               # (D, p, p, H, W)
+        flat = img_sel.reshape(output_dim, p * p, h * w)
+        kidx = jnp.repeat(jnp.arange(p * p), sp * sp)
+
+        def gather(yi, xi):
+            return flat[:, kidx, yi * w + xi]        # (D, p*p*sp*sp)
+
+        samp = (gather(y0, x0) * ((1 - wy) * (1 - wx))
+                + gather(y0, x1i) * ((1 - wy) * wx)
+                + gather(y1i, x0) * (wy * (1 - wx))
+                + gather(y1i, x1i) * (wy * wx))
+        samp = samp.reshape(output_dim, p, p, sp * sp).mean(-1)
+        return samp
+
+    if no_trans or trans is None:
+        trans = jnp.zeros((rois.shape[0], 2, p, p), data.dtype)
+    return jax.vmap(one)(rois, trans)
+
+
+@register("RROIAlign", aliases=("rroi_align", "contrib_RROIAlign"))
+def rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Rotated ROI align (reference contrib RROIAlign): rois (R, 6) =
+    [batch, cx, cy, w, h, angle_degrees]; bilinear-sample a pooled_size
+    grid over the rotated box."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    n, c, h, w = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * jnp.pi / 180.0
+        iy = (jnp.arange(ph, dtype=jnp.float32) + 0.5) / ph - 0.5
+        ix = (jnp.arange(pw, dtype=jnp.float32) + 0.5) / pw - 0.5
+        ly = iy[:, None] * rh                        # (ph, 1)
+        lx = ix[None, :] * rw                        # (1, pw)
+        ct, st = jnp.cos(theta), jnp.sin(theta)
+        sx = cx + lx * ct - ly * st                  # (ph, pw)
+        sy = cy + lx * st + ly * ct
+        yy = sy.reshape(-1)
+        xx = sx.reshape(-1)
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        wy = jnp.clip(yy - y0, 0.0, 1.0).astype(data.dtype)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wx = jnp.clip(xx - x0, 0.0, 1.0).astype(data.dtype)
+        img = data[b].reshape(c, h * w)
+        samp = (img[:, y0 * w + x0] * ((1 - wy) * (1 - wx))
+                + img[:, y0 * w + x1i] * ((1 - wy) * wx)
+                + img[:, y1i * w + x0] * (wy * (1 - wx))
+                + img[:, y1i * w + x1i] * (wy * wx))
+        return samp.reshape(c, ph, pw)
+
+    return jax.vmap(one)(rois)

@@ -275,21 +275,21 @@ int EncodeJpeg(const uint8_t* rgb, int h, int w, int quality,
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = JpegErrExit;
-  // volatile: modified between setjmp and a potential longjmp (C11
-  // 7.13.2.1 — non-volatile locals are indeterminate after longjmp)
-  unsigned char* volatile buf = nullptr;
-  unsigned long buflen = 0;
+  // The mem destination's buffer pointer must (a) survive longjmp
+  // (C11 7.13.2.1: non-volatile locals modified after setjmp are
+  // indeterminate) and (b) have a stable ADDRESS for libjpeg to write
+  // reallocations through for the whole compress lifetime. Heap-box it:
+  // the box pointer is set before setjmp and never changes.
+  struct MemDst { unsigned char* buf; unsigned long len; };
+  MemDst* dst = new MemDst{nullptr, 0};
   if (setjmp(jerr.jmp)) {
     jpeg_destroy_compress(&cinfo);
-    if (buf) free(buf);
+    if (dst->buf) free(dst->buf);
+    delete dst;
     return 1;
   }
   jpeg_create_compress(&cinfo);
-  {
-    unsigned char* tmp = buf;
-    jpeg_mem_dest(&cinfo, &tmp, &buflen);
-    buf = tmp;
-  }
+  jpeg_mem_dest(&cinfo, &dst->buf, &dst->len);
   cinfo.image_width = static_cast<JDIMENSION>(w);
   cinfo.image_height = static_cast<JDIMENSION>(h);
   cinfo.input_components = 3;
@@ -306,9 +306,10 @@ int EncodeJpeg(const uint8_t* rgb, int h, int w, int quality,
     jpeg_write_scanlines(&cinfo, rows, 1);
   }
   jpeg_finish_compress(&cinfo);
-  out->assign(buf, buf + buflen);
+  out->assign(dst->buf, dst->buf + dst->len);
   jpeg_destroy_compress(&cinfo);
-  free(buf);
+  free(dst->buf);
+  delete dst;
   return 0;
 }
 

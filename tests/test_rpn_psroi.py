@@ -1,0 +1,183 @@
+"""Round-4 detection-op completions: RPN Proposal/MultiProposal and the
+position-sensitive / rotated ROI pooling family (reference
+src/operator/contrib/{proposal,psroi_pooling,deformable_psroi_pooling,
+rroi_align}.cc — previously documented deliberate skips)."""
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import ndarray as nd
+from incubator_mxnet_tpu.ops.registry import get
+
+
+def test_proposal_selects_high_score_anchor():
+    """One dominant objectness peak with zero deltas must produce a roi
+    at that anchor's (clipped) location, first in the output."""
+    import jax.numpy as jnp
+
+    a, h, w = 3, 8, 8        # 1 scale x 3 ratios
+    cls = np.full((1, 2 * a, h, w), 0.01, np.float32)
+    cls[0, a + 1, 4, 5] = 0.99            # anchor ratio idx 1 at (4, 5)
+    bbox = np.zeros((1, 4 * a, h, w), np.float32)
+    im_info = np.array([[128.0, 128.0, 1.0]], np.float32)
+
+    rois, scores = get("Proposal").fn(
+        jnp.asarray(cls), jnp.asarray(bbox), jnp.asarray(im_info),
+        feature_stride=16, scales=(2,), ratios=(0.5, 1, 2),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, output_score=True)
+    rois = np.asarray(rois)
+    scores = np.asarray(scores)
+    assert rois.shape == (8, 5)
+    assert scores[0, 0] == pytest.approx(0.99)
+    # top roi centered near (5*16 + 7.5, 4*16 + 7.5) = (87.5, 71.5)
+    x1, y1, x2, y2 = rois[0, 1:]
+    assert abs((x1 + x2) / 2 - 87.5) < 1.5
+    assert abs((y1 + y2) / 2 - 71.5) < 1.5
+    # ratio=1, scale=2, stride=16 -> ~32x32 box, fully inside the image
+    assert 0 <= x1 <= x2 <= 127 and 0 <= y1 <= y2 <= 127
+    assert 28 <= x2 - x1 <= 36 and 28 <= y2 - y1 <= 36
+    assert rois[0, 0] == 0.0              # batch index
+
+
+def test_proposal_nms_suppresses_duplicates():
+    import jax.numpy as jnp
+
+    a, h, w = 1, 4, 4
+    cls = np.full((1, 2, h, w), 0.01, np.float32)
+    # two adjacent cells -> same-ish box after clipping, one must go
+    cls[0, 1, 1, 1] = 0.9
+    cls[0, 1, 1, 2] = 0.8
+    cls[0, 1, 3, 3] = 0.7                 # far away, survives
+    bbox = np.zeros((1, 4, h, w), np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois, scores = get("Proposal").fn(
+        jnp.asarray(cls), jnp.asarray(bbox), jnp.asarray(im_info),
+        feature_stride=16, scales=(4,), ratios=(1,),
+        rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4, threshold=0.5,
+        rpn_min_size=1, output_score=True)
+    s = np.asarray(scores).ravel()
+    assert s[0] == pytest.approx(0.9)
+    # the 0.8 heavily-overlapping box suppressed; 0.7 survivor ranks 2nd
+    assert s[1] == pytest.approx(0.7)
+
+
+def test_multi_proposal_batches():
+    import jax.numpy as jnp
+
+    a, h, w = 1, 4, 4
+    cls = np.full((2, 2, h, w), 0.01, np.float32)
+    cls[0, 1, 0, 0] = 0.9
+    cls[1, 1, 3, 3] = 0.9
+    bbox = np.zeros((2, 4, h, w), np.float32)
+    im_info = np.tile(np.array([[64.0, 64.0, 1.0]], np.float32), (2, 1))
+    rois = np.asarray(get("MultiProposal").fn(
+        jnp.asarray(cls), jnp.asarray(bbox), jnp.asarray(im_info),
+        feature_stride=16, scales=(8,), ratios=(1,),
+        rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4, threshold=0.7,
+        rpn_min_size=1))
+    assert rois.shape == (8, 5)
+    np.testing.assert_array_equal(rois[:4, 0], 0.0)
+    np.testing.assert_array_equal(rois[4:, 0], 1.0)
+
+
+def test_psroi_pooling_position_sensitivity():
+    """Each output bin must read ITS channel block: constant-per-block
+    input -> output equals the block constants."""
+    import jax.numpy as jnp
+
+    g, d = 2, 3
+    h = w = 8
+    data = np.zeros((1, d * g * g, h, w), np.float32)
+    for dd in range(d):
+        for i in range(g):
+            for j in range(g):
+                data[0, dd * g * g + i * g + j] = 100 * dd + 10 * i + j
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = np.asarray(get("PSROIPooling").fn(
+        jnp.asarray(data), jnp.asarray(rois), spatial_scale=1.0,
+        output_dim=d, pooled_size=g))
+    assert out.shape == (1, d, g, g)
+    for dd in range(d):
+        for i in range(g):
+            for j in range(g):
+                assert out[0, dd, i, j] == pytest.approx(
+                    100 * dd + 10 * i + j), (dd, i, j)
+
+
+def test_deformable_psroi_no_trans_matches_constant_blocks():
+    import jax.numpy as jnp
+
+    g, d = 2, 2
+    h = w = 8
+    data = np.zeros((1, d * g * g, h, w), np.float32)
+    for dd in range(d):
+        for i in range(g):
+            for j in range(g):
+                data[0, dd * g * g + i * g + j] = 7 * dd + 2 * i + j
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = np.asarray(get("DeformablePSROIPooling").fn(
+        jnp.asarray(data), jnp.asarray(rois), None, spatial_scale=1.0,
+        output_dim=d, pooled_size=g, sample_per_part=2, no_trans=True))
+    assert out.shape == (1, d, g, g)
+    for dd in range(d):
+        for i in range(g):
+            for j in range(g):
+                assert out[0, dd, i, j] == pytest.approx(
+                    7 * dd + 2 * i + j, abs=1e-5)
+
+
+def test_deformable_psroi_trans_shifts_bins():
+    import jax.numpy as jnp
+
+    # left half 0, right half 1: a positive x-offset on every bin pushes
+    # samples right -> outputs increase
+    data = np.zeros((1, 4, 8, 8), np.float32)
+    data[:, :, :, 4:] = 1.0
+    rois = np.array([[0, 0, 0, 3, 7]], np.float32)   # left half
+    base = np.asarray(get("DeformablePSROIPooling").fn(
+        jnp.asarray(data), jnp.asarray(rois), None, spatial_scale=1.0,
+        output_dim=1, pooled_size=2, sample_per_part=2, no_trans=True))
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    trans[:, 0] = 10.0                                # big +x offset
+    shifted = np.asarray(get("DeformablePSROIPooling").fn(
+        jnp.asarray(data), jnp.asarray(rois), jnp.asarray(trans),
+        spatial_scale=1.0, output_dim=1, pooled_size=2,
+        sample_per_part=2, trans_std=0.1))
+    assert shifted.sum() > base.sum()
+
+
+def test_rroi_align_axis_aligned_matches_region():
+    import jax.numpy as jnp
+
+    data = np.zeros((1, 1, 8, 8), np.float32)
+    data[0, 0, 2:6, 2:6] = 5.0
+    # angle 0, centered on the hot region
+    rois = np.array([[0, 3.5, 3.5, 4, 4, 0.0]], np.float32)
+    out = np.asarray(get("RROIAlign").fn(
+        jnp.asarray(data), jnp.asarray(rois), pooled_size=(2, 2),
+        spatial_scale=1.0))
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out, 5.0, rtol=1e-5)
+
+
+def test_rroi_align_rotation_changes_samples():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    data = rs.rand(1, 2, 12, 12).astype(np.float32)
+    roi0 = np.array([[0, 6, 6, 8, 3, 0.0]], np.float32)
+    roi90 = np.array([[0, 6, 6, 8, 3, 90.0]], np.float32)
+    o0 = np.asarray(get("RROIAlign").fn(
+        jnp.asarray(data), jnp.asarray(roi0), pooled_size=(2, 4)))
+    o90 = np.asarray(get("RROIAlign").fn(
+        jnp.asarray(data), jnp.asarray(roi90), pooled_size=(2, 4)))
+    assert o0.shape == o90.shape == (1, 2, 2, 4)
+    assert not np.allclose(o0, o90)
+
+
+def test_ops_reachable_from_nd_contrib():
+    for name in ("Proposal", "MultiProposal", "PSROIPooling",
+                 "DeformablePSROIPooling", "RROIAlign"):
+        assert get(name) is not None, name
+        assert get(f"contrib_{name}") is not None, name
