@@ -142,6 +142,14 @@ config.register(
 
 
 config.register(
+    "MXTPU_FLASH_MIN_SEQ", 2048, int,
+    "Sequence-length crossover for flash_attention dispatch: below this "
+    "(max of Tq, Tk) the XLA dense-softmax path is used — the measured "
+    "Pallas-kernel crossover on v5e is ~2k (PROFILE.md: backward 0.47x "
+    "XLA at T=1024, 1.8x at 2048). Set 0 to always take the Pallas "
+    "kernels (the cuDNN algo-selection analog: reference "
+    "src/operator/nn/cudnn/ autotune registry).")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

@@ -455,14 +455,37 @@ def _flash_core_bwd(scale, causal, interpret, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def _use_pallas_path(tq, tk, interpret):
+    """Size-aware algo selection (the cuDNN-autotune-registry analog).
+
+    An explicit ``interpret=`` pins the Pallas path (tests exercise the
+    kernels at tiny shapes that way). Otherwise sequences below the
+    measured crossover (``MXTPU_FLASH_MIN_SEQ``, default 2048 — PROFILE.md:
+    Pallas backward is 0.47x XLA at T=1024 but 1.8x/4.7x at 2048/4096)
+    take the XLA dense path in both directions."""
+    if interpret is not None:
+        return True
+    from ..config import config
+
+    min_seq = int(config.get("MXTPU_FLASH_MIN_SEQ"))
+    return min_seq <= 0 or max(tq, tk) >= min_seq
+
+
 @register("flash_attention")
 def flash_attention(q, k, v, lengths=None, scale=None, causal=False,
                     interpret=None):
     """Block-tiled flash attention. q, k, v: (B, H, T, D); ``lengths``
     (B,) optional per-sample valid key length. The TPU analog of a
-    hand-written fused attention CUDA kernel; see module docstring."""
+    hand-written fused attention CUDA kernel; see module docstring.
+
+    Dispatch: below the measured Pallas crossover (``MXTPU_FLASH_MIN_SEQ``)
+    the mathematically identical XLA dense path runs instead — same
+    contract, chosen by size the way the reference's cuDNN autotune
+    registry picks an algo per shape."""
     d = q.shape[-1]
     s = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if not _use_pallas_path(q.shape[2], k.shape[2], interpret):
+        return _xla_reference(q, k, v, lengths, s, bool(causal))
     if interpret is None:
         interpret = not pallas_available()
     return _flash_core(q, k, v, lengths, s, bool(causal), bool(interpret))

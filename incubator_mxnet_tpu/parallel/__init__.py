@@ -20,5 +20,6 @@ from .collectives import (allreduce_across_processes, allreduce_arrays,
                           init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
 from .pipeline import (PipelineTrainer, pipeline_apply,
-                       pipeline_apply_1f1b, stack_stage_params)
+                       pipeline_apply_1f1b, pipeline_apply_interleaved,
+                       stack_stage_params)
 from .checkpoint import restore_sharded, save_sharded

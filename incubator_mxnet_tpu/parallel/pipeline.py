@@ -50,6 +50,14 @@ from .mesh import DATA_AXIS, PIPE_AXIS, make_mesh, mesh_scope
 from .spmd import _to_optax, collect_params, functional_apply
 
 
+def _device_major_perm(S: int, V: int) -> np.ndarray:
+    """Interleaved-storage permutation: ``storage[d*V + c] = stage[c*S + d]``
+    so PartitionSpec(pipe) on the leading axis puts device ``d``'s chunk
+    set ``{d, d+S, ..., d+(V-1)S}`` on it directly. Inverse =
+    ``np.argsort`` of this."""
+    return np.array([c * S + d for d in range(S) for c in range(V)])
+
+
 def stack_stage_params(stage_params: Sequence[Dict[str, Any]]
                        ) -> Dict[str, Any]:
     """Stack per-stage parameter dicts (identical structure) on a new
@@ -135,11 +143,113 @@ def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
     return y_mb.reshape(B, *y_mb.shape[2:])
 
 
+def pipeline_apply_interleaved(
+        stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
+        stacked_params: Dict[str, Any],
+        x: jax.Array, *,
+        mesh: Mesh,
+        num_microbatches: Optional[int] = None,
+        pipe_axis: str = PIPE_AXIS,
+        data_axis: Optional[str] = None,
+        device_major: bool = False) -> jax.Array:
+    """Megatron interleaved (virtual-stage) schedule: ``V*S`` virtual
+    stages with device ``d`` holding the NON-contiguous chunk set
+    ``{d, d+S, d+2S, ...}``, so each microbatch makes ``V`` trips around
+    the ring (Narayanan et al. 2021 §2.2, the circular-pipeline
+    formulation). The bubble shrinks from ``(S-1)/(M+S-1)`` ticks
+    (GPipe/plain 1F1B) to ``(S-1)/(M*V+S-1)`` — a ``V``-fold relative
+    reduction — at the cost of ``V``x the ppermute traffic.
+
+    ``stacked_params`` leading axis is ``V*S`` in NATURAL stage order
+    (stage ``l`` applied ``l``-th); the device-major reorder happens
+    internally — or pass ``device_major=True`` if the caller already
+    stores them reordered (``storage[d*V + c] = stage[c*S + d]``, what
+    :class:`PipelineTrainer` does so no per-step reshuffle collective is
+    ever paid). ``M`` must be a multiple of ``S`` (same restriction as
+    Megatron's interleaved schedule). Differentiable: ``jax.grad``
+    transposes the scan into the mirrored interleaved backward.
+
+    Schedule derivation (one activation hop per tick): the group-``g``
+    microbatch with injection residue ``r`` enters at tick
+    ``g*V*S + r`` — exactly when the group-``g-1`` same-residue
+    microbatch retires — so in steady state all ``S`` residue slots are
+    occupied and every device is busy every tick. At tick ``t`` device
+    ``d`` serves virtual stage ``v = (t - r) mod V*S`` with
+    ``r = (t - d) mod S``; ``v mod S == d`` always, and the chunk is
+    ``v // S``.
+    """
+    S = mesh.shape[pipe_axis]
+    leading = {int(np.shape(a)[0]) for a in jax.tree.leaves(stacked_params)}
+    if len(leading) != 1 or next(iter(leading)) % S:
+        raise ValueError(
+            f"stacked virtual-stage axis {sorted(leading)} must be a "
+            f"multiple of the pipe axis size {S}")
+    VS = next(iter(leading))
+    V = VS // S
+    M = int(num_microbatches or S)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"the pipe axis size ({S})")
+    if data_axis is not None and (B // M) % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch size {B // M} not divisible by data axis "
+            f"{data_axis!r} size {mesh.shape[data_axis]}")
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    T = M * V + S - 1
+    ring = [(i, (i + 1) % S) for i in range(S)]
+    if device_major:
+        reordered = stacked_params
+    else:
+        perm = _device_major_perm(S, V)
+        reordered = jax.tree.map(lambda a: jnp.asarray(a)[perm],
+                                 stacked_params)
+
+    def per_device(params, mb):
+        idx = lax.axis_index(pipe_axis)
+
+        def tick(carry, t):
+            state, outs = carry
+            r = jnp.mod(t - idx, S)
+            g = jnp.where(t >= r, (t - r) // (V * S), 0)
+            v = t - (g * V * S + r)          # in [0, V*S) when t >= r
+            c = v // S                       # chunk on this device
+            m = g * S + r
+            active = jnp.logical_and(t >= r, m < M)
+            inj = mb[jnp.clip(m, 0, M - 1)]
+            cur = jnp.where(v == 0, inj, state)
+            p_c = jax.tree.map(lambda a: a[jnp.clip(c, 0, V - 1)], params)
+            y = stage_fn(p_c, cur)
+            done = jnp.logical_and(active, v == VS - 1)   # only on S-1
+            outs = jnp.where(done, outs.at[jnp.clip(m, 0, M - 1)].set(y),
+                             outs)
+            return (lax.ppermute(y, pipe_axis, ring), outs), None
+
+        init = (jnp.zeros_like(mb[0]), jnp.zeros_like(mb))
+        (_, outs), _ = lax.scan(tick, init, jnp.arange(T))
+        contrib = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(contrib, pipe_axis)
+
+    pspec = jax.tree.map(lambda _: PartitionSpec(pipe_axis), reordered)
+    mb_spec = PartitionSpec(None, data_axis) if data_axis else \
+        PartitionSpec()
+    y_mb = jax.shard_map(per_device, mesh=mesh,
+                         in_specs=(pspec, mb_spec),
+                         out_specs=mb_spec, check_vma=False)(
+        reordered, x_mb)
+    return y_mb.reshape(B, *y_mb.shape[2:])
+
+
 def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
                         *, mesh: Mesh,
                         num_microbatches: Optional[int] = None,
                         pipe_axis: str = PIPE_AXIS,
-                        data_axis: Optional[str] = None):
+                        data_axis: Optional[str] = None,
+                        epilogue_fn: Optional[Callable] = None,
+                        epilogue_params: Optional[Dict[str, Any]] = None):
     """One-forward-one-backward (1F1B) schedule: forward AND backward of
     different microbatches interleave in ONE ``lax.scan``, with the loss
     applied per-microbatch at the last stage.
@@ -156,6 +266,14 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
     Returns ``(mean_loss, dx, stage_grads)`` where ``dx`` is the
     cotangent of ``x`` (shape of ``x``) and ``stage_grads`` mirrors
     ``stacked_params`` (stage-stacked, sharded over ``pipe_axis``).
+
+    ``epilogue_fn(epilogue_params, h_mb) -> logits_mb`` (optional) runs a
+    replicated head per-microbatch AT the last stage before the loss —
+    the Megatron placement (the LM head lives on the final pipeline
+    stage), which keeps the 1F1B interleave intact where a whole-batch
+    epilogue would force the GPipe all-microbatches-first structure
+    back. Must be stateless (no BatchNorm running stats). When given,
+    returns ``(mean_loss, dx, stage_grads, epilogue_grads)``.
     """
     S = mesh.shape[pipe_axis]
     n_stages = {int(np.shape(a)[0]) for a in jax.tree.leaves(stacked_params)}
@@ -180,13 +298,14 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
     fwd_ring = [(i, (i + 1) % S) for i in range(S)]
     bwd_ring = [(i, (i - 1) % S) for i in range(S)]
 
-    def per_device(params, mb, lbl):
+    def per_device(params, epi_p, mb, lbl):
         params = jax.tree.map(lambda a: a[0], params)
         idx = lax.axis_index(pipe_axis)
         is_last = idx == S - 1
 
         def tick(carry, t):
-            state_f, state_b, stash, grad_acc, dx_acc, loss_acc = carry
+            (state_f, state_b, stash, grad_acc, dx_acc, loss_acc,
+             epi_acc) = carry
             m_f = t - idx
             active_f = jnp.logical_and(m_f >= 0, m_f < M)
             inj = mb[jnp.clip(m_f, 0, M - 1)]
@@ -195,8 +314,18 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
                               stash.at[jnp.mod(m_f, K)].set(cur), stash)
             y = stage_fn(params, cur)
             lbl_m = lbl[jnp.clip(m_f, 0, M - 1)]
-            loss_m, dy = jax.value_and_grad(
-                lambda yy: per_mb_loss(yy, lbl_m))(y)
+            if epilogue_fn is None:
+                loss_m, dy = jax.value_and_grad(
+                    lambda yy: per_mb_loss(yy, lbl_m))(y)
+            else:
+                loss_m, (dy, depi) = jax.value_and_grad(
+                    lambda yy, ep: per_mb_loss(epilogue_fn(ep, yy), lbl_m),
+                    argnums=(0, 1))(y, epi_p)
+                epi_acc = jax.tree.map(
+                    lambda a, d: a + jnp.where(
+                        jnp.logical_and(is_last, active_f),
+                        d.astype(jnp.float32) / (M * n_data), 0.0),
+                    epi_acc, depi)
             # total loss = mean over microbatches AND over data replicas;
             # the cotangent carries both factors so dx comes out in
             # global-loss units (grads then psum over data)
@@ -224,7 +353,7 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
             state_b = lax.ppermute(jnp.where(active_b, dx, 0.0),
                                    pipe_axis, bwd_ring)
             return (state_f, state_b, stash, grad_acc, dx_acc,
-                    loss_acc), None
+                    loss_acc, epi_acc), None
 
         init = (jnp.zeros_like(mb[0]),
                 jnp.zeros_like(mb[0]),
@@ -232,11 +361,16 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
                 jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
                              params),
                 jnp.zeros_like(mb),
-                jnp.zeros((), jnp.float32))
-        (_, _, _, grad_acc, dx_acc, loss_acc), _ = lax.scan(
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             epi_p))
+        (_, _, _, grad_acc, dx_acc, loss_acc, epi_acc), _ = lax.scan(
             tick, init, jnp.arange(T))
         loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), pipe_axis) / M
         dx_out = lax.psum(jnp.where(idx == 0, dx_acc, 0.0), pipe_axis)
+        epi_out = jax.tree.map(
+            lambda a: lax.psum(jnp.where(is_last, a, 0.0), pipe_axis),
+            epi_acc)
         if data_axis is not None:
             # DP composition: every data replica saw only its shard —
             # reduce loss and parameter grads across the data axis (dx
@@ -245,18 +379,24 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
             loss = lax.pmean(loss, data_axis)
             grad_acc = jax.tree.map(
                 lambda g: lax.psum(g, data_axis), grad_acc)
+            epi_out = jax.tree.map(
+                lambda g: lax.psum(g, data_axis), epi_out)
         grads = jax.tree.map(lambda g: g[None], grad_acc)  # restack
-        return loss, dx_out, grads
+        return loss, dx_out, grads, epi_out
 
     pspec = jax.tree.map(lambda _: PartitionSpec(pipe_axis), stacked_params)
     mb_spec = PartitionSpec(None, data_axis) if data_axis else \
         PartitionSpec()
-    loss_v, dx_mb, grads = jax.shard_map(
+    epi_p = epilogue_params if epilogue_params is not None else {}
+    epi_spec = jax.tree.map(lambda _: PartitionSpec(), epi_p)
+    loss_v, dx_mb, grads, epi_grads = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(pspec, mb_spec, mb_spec),
-        out_specs=(PartitionSpec(), mb_spec, pspec),
-        check_vma=False)(stacked_params, x_mb, y_mb)
-    return loss_v, dx_mb.reshape(x.shape), grads
+        in_specs=(pspec, epi_spec, mb_spec, mb_spec),
+        out_specs=(PartitionSpec(), mb_spec, pspec, epi_spec),
+        check_vma=False)(stacked_params, epi_p, x_mb, y_mb)
+    if epilogue_fn is None:
+        return loss_v, dx_mb.reshape(x.shape), grads
+    return loss_v, dx_mb.reshape(x.shape), grads, epi_grads
 
 
 class PipelineTrainer:
@@ -286,21 +426,20 @@ class PipelineTrainer:
                  data_axis: Optional[str] = DATA_AXIS,
                  donate: bool = True,
                  schedule: str = "gpipe"):
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
-                             f"got {schedule!r}")
-        if schedule == "1f1b" and epilogue is not None:
-            # 1F1B applies the loss per-microbatch AT the last stage; a
-            # replicated whole-batch epilogue would force the GPipe
-            # all-microbatches-first structure back
-            raise ValueError("schedule='1f1b' does not support an "
-                             "epilogue block; fold it into the last "
-                             "stage or the loss_fn")
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(f"schedule must be 'gpipe', '1f1b' or "
+                             f"'interleaved', got {schedule!r}")
         self.schedule = schedule
         self.mesh = mesh if mesh is not None else make_mesh(
             {pipe_axis: len(stages)})
         S = self.mesh.shape[pipe_axis]
-        if len(stages) != S:
+        if schedule == "interleaved":
+            # V*S virtual stages, V non-contiguous chunks per device
+            if len(stages) % S:
+                raise ValueError(
+                    f"interleaved schedule needs a stage count divisible "
+                    f"by the pipe axis size {S}, got {len(stages)}")
+        elif len(stages) != S:
             raise ValueError(
                 f"{len(stages)} stages but pipe axis has {S} devices")
         self.stages = list(stages)
@@ -323,6 +462,15 @@ class PipelineTrainer:
         stacked = stack_stage_params(
             [{n: p._data._data for n, p in collect_params(st).items()}
              for st in self.stages])
+        if schedule == "interleaved":
+            # store device-major (storage[d*V+c] = stage[c*S+d]) so the
+            # pipe sharding puts each device's chunk set on it directly —
+            # no per-step reorder collective
+            self._stage_perm = _device_major_perm(S, len(stages) // S)
+            stacked = {n: a[jnp.asarray(self._stage_perm)]
+                       for n, a in stacked.items()}
+        else:
+            self._stage_perm = None
         pipe_shard = lambda a: jax.device_put(a, NamedSharding(
             self.mesh, PartitionSpec(pipe_axis)))
         repl = lambda a: jax.device_put(a, NamedSharding(
@@ -332,6 +480,17 @@ class PipelineTrainer:
             OrderedDict()
         self._epi_objs = collect_params(epilogue) if epilogue is not None else \
             OrderedDict()
+        if schedule == "1f1b":
+            # the per-microbatch epilogue path discards aux state writes —
+            # a BatchNorm head would train with silently-frozen running
+            # stats (gpipe updates them); fail loud instead
+            stateful = [n for n in self._epi_objs if "running_" in n]
+            if stateful:
+                raise ValueError(
+                    f"schedule='1f1b' requires a stateless epilogue; "
+                    f"{stateful} are running statistics that this "
+                    f"schedule would silently freeze — use "
+                    f"schedule='gpipe' or a norm without batch state")
 
         # grad_req='null' parameters (frozen weights, BatchNorm running
         # stats) live in self.frozen — never touched by the optimizer,
@@ -362,6 +521,7 @@ class PipelineTrainer:
         template = self.stages[0]
         stage_objs = self._stage_objs
         pro, pro_objs = self.prologue, self._pro_objs
+        epi, epi_objs = self.epilogue, self._epi_objs
         loss_fn, tx, mesh = self.loss_fn, self.tx, self.mesh
         pipe_axis, data_axis = self.pipe_axis, self.data_axis
         M = self.num_microbatches
@@ -386,14 +546,35 @@ class PipelineTrainer:
                             xx)
                         return out
                     h, vjp_pro = jax.vjp(pro_fn, params["prologue"], x)
-                loss, dh, stage_grads = pipeline_apply_1f1b(
-                    stage_fn, merged_stages, h, y, per_mb_loss,
-                    mesh=mesh, num_microbatches=M, pipe_axis=pipe_axis,
-                    data_axis=data_axis)
+                if epi is not None:
+                    # replicated per-microbatch head AT the last stage
+                    # (Megatron placement); must be stateless — frozen
+                    # epilogue values (e.g. BN running stats) are read
+                    # but never updated under this schedule
+                    def epi_fn(ep, hh):
+                        out, _ = functional_apply(
+                            epi, epi_objs, {**ep, **frozen["epilogue"]},
+                            hh)
+                        return out
+                    loss, dh, stage_grads, epi_grads = pipeline_apply_1f1b(
+                        stage_fn, merged_stages, h, y, per_mb_loss,
+                        mesh=mesh, num_microbatches=M,
+                        pipe_axis=pipe_axis, data_axis=data_axis,
+                        epilogue_fn=epi_fn,
+                        epilogue_params=params["epilogue"])
+                else:
+                    loss, dh, stage_grads = pipeline_apply_1f1b(
+                        stage_fn, merged_stages, h, y, per_mb_loss,
+                        mesh=mesh, num_microbatches=M,
+                        pipe_axis=pipe_axis, data_axis=data_axis)
+                    epi_grads = {}
                 grads = {"stages": {
                     n: stage_grads[n].astype(params["stages"][n].dtype)
                     for n in params["stages"]},
-                    "prologue": {}, "epilogue": {}}
+                    "prologue": {},
+                    "epilogue": {
+                        n: epi_grads[n].astype(params["epilogue"][n].dtype)
+                        for n in params["epilogue"]}}
                 if pro is not None:
                     grads["prologue"] = vjp_pro(dh.astype(h.dtype))[0]
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -431,9 +612,16 @@ class PipelineTrainer:
                         pro, pro_objs,
                         {**params["prologue"], **frozen["prologue"]}, h)
                     aux_updates["prologue"] = aux
-                h = pipeline_apply(stage_fn, merged_stages, h, mesh=mesh,
-                                   num_microbatches=M, pipe_axis=pipe_axis,
-                                   data_axis=data_axis)
+                if self.schedule == "interleaved":
+                    h = pipeline_apply_interleaved(
+                        stage_fn, merged_stages, h, mesh=mesh,
+                        num_microbatches=M, pipe_axis=pipe_axis,
+                        data_axis=data_axis, device_major=True)
+                else:
+                    h = pipeline_apply(
+                        stage_fn, merged_stages, h, mesh=mesh,
+                        num_microbatches=M, pipe_axis=pipe_axis,
+                        data_axis=data_axis)
                 if epi is not None:
                     h, aux = functional_apply(
                         epi, epi_objs,
@@ -489,10 +677,13 @@ class PipelineTrainer:
         """Write trainer-owned values back into the stage/prologue/epilogue
         Blocks (unstacking the stage axis)."""
         stacked = {**self.params["stages"], **self.frozen["stages"]}
+        if self._stage_perm is not None:     # interleaved: device-major
+            inv = np.argsort(self._stage_perm)
         for i, st in enumerate(self.stages):
             objs = collect_params(st)
+            si = int(inv[i]) if self._stage_perm is not None else i
             for n, p in objs.items():
-                p._data._set_data(stacked[n][i])
+                p._data._set_data(stacked[n][si])
         for key, objs in (("prologue", self._pro_objs),
                           ("epilogue", self._epi_objs)):
             vals = {**self.params[key], **self.frozen[key]}

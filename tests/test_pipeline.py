@@ -373,15 +373,187 @@ def test_pipeline_1f1b_with_prologue_converges():
     assert losses[-1] < losses[0] * 0.7, losses[::10]
 
 
-def test_pipeline_1f1b_rejects_epilogue():
-    stages = _make_stages(4, 8)
-    head = nn.Dense(4, in_units=8)
-    head.initialize(init="xavier")
-    head(mx.nd.zeros((1, 8)))
-    with pytest.raises(ValueError, match="epilogue"):
-        parallel.PipelineTrainer(
-            stages, gluon.loss.L2Loss(), mesh=_pipe_mesh(4),
-            epilogue=head, schedule="1f1b")
+def test_pipeline_1f1b_epilogue_loss_and_grads_match_sequential():
+    """1F1B with a per-microbatch replicated epilogue at the last stage
+    (round 5, VERDICT item 5): loss, dx, stage grads AND epilogue grads
+    must be oracle-exact vs the sequential composition."""
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(14)
+    S, D, C = 4, 8, 3
+    stacked = {
+        "w": jnp.asarray(np.random.randn(S, D, D).astype(np.float32) * 0.3)}
+    epi_p = {"wh": jnp.asarray(
+        np.random.randn(D, C).astype(np.float32) * 0.5)}
+    mesh = _pipe_mesh(S)
+    x = jnp.asarray(np.random.randn(16, D).astype(np.float32))
+    y = jnp.asarray(np.random.randn(16, C).astype(np.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def epi_fn(ep, h):
+        return h @ ep["wh"]
+
+    def per_mb_loss(logits, lbl):
+        return jnp.mean((logits - lbl) ** 2)
+
+    for M in (4, 8):
+        loss, dx, grads, epi_grads = parallel.pipeline_apply_1f1b(
+            stage_fn, stacked, x, y, per_mb_loss, mesh=mesh,
+            num_microbatches=M, epilogue_fn=epi_fn,
+            epilogue_params=epi_p)
+
+        def seq_loss(params, ep, xx):
+            h = xx
+            for i in range(S):
+                h = jnp.tanh(h @ params["w"][i])
+            return jnp.mean((h @ ep["wh"] - y) ** 2)
+
+        ref_l, (g_ref, ge_ref, dx_ref) = jax.value_and_grad(
+            seq_loss, argnums=(0, 1, 2))(stacked, epi_p, x)
+        assert abs(float(loss) - float(ref_l)) < 2e-6, M
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(g_ref["w"]),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"M={M}")
+        np.testing.assert_allclose(np.asarray(epi_grads["wh"]),
+                                   np.asarray(ge_ref["wh"]),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"M={M}")
+
+
+def test_pipeline_1f1b_trainer_epilogue_matches_gpipe():
+    """PipelineTrainer(schedule='1f1b', epilogue=head): one optimizer step
+    equals the GPipe trainer's (same math, Megatron head placement)."""
+    np.random.seed(15)
+    S, D, C = 4, 8, 3
+
+    def build(schedule):
+        np.random.seed(15)
+        mx.random.seed(15)
+        stages = _make_stages(S, D)
+        head = nn.Dense(C, in_units=D)
+        head.initialize(init="xavier")
+        head(mx.nd.zeros((1, D)))
+        return parallel.PipelineTrainer(
+            stages, gluon.loss.L2Loss(), "sgd", {"learning_rate": 0.1},
+            mesh=_pipe_mesh(S), data_axis=None, donate=False,
+            epilogue=head, schedule=schedule)
+
+    x = np.random.RandomState(16).rand(8, D).astype(np.float32)
+    y = np.random.RandomState(17).rand(8, C).astype(np.float32)
+    pt_g, pt_f = build("gpipe"), build("1f1b")
+    lg, lf = float(pt_g.step(x, y)), float(pt_f.step(x, y))
+    assert abs(lg - lf) < 2e-6, (lg, lf)
+    for group in ("stages", "epilogue"):
+        for n in pt_g.params[group]:
+            np.testing.assert_allclose(
+                np.asarray(pt_f.params[group][n]),
+                np.asarray(pt_g.params[group][n]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{group}.{n}")
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedule (round 5, VERDICT item 5)
+# ---------------------------------------------------------------------------
+def test_pipeline_interleaved_matches_sequential():
+    """V*S virtual stages, device d holding chunks {d, d+S, ...}: the
+    circular schedule must equal the sequential composition for every
+    (V, M) combination."""
+    import jax.numpy as jnp
+
+    np.random.seed(20)
+    S, D = 4, 8
+    mesh = _pipe_mesh(S)
+    x = np.random.randn(16, D).astype(np.float32)
+
+    for V, M in ((2, 4), (2, 8), (3, 4)):
+        VS = V * S
+        ws = [np.random.randn(D, D).astype(np.float32) * 0.3
+              for _ in range(VS)]
+        stacked = {"w": jnp.asarray(np.stack(ws))}
+        y = np.asarray(parallel.pipeline_apply_interleaved(
+            lambda p, h: jnp.tanh(h @ p["w"]), stacked, jnp.asarray(x),
+            mesh=mesh, num_microbatches=M))
+        ref = x
+        for w in ws:
+            ref = np.tanh(ref @ w)
+        np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"V={V} M={M}")
+
+
+def test_pipeline_interleaved_grad_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(21)
+    S, D, V = 4, 8, 2
+    mesh = _pipe_mesh(S)
+    stacked = {"w": jnp.asarray(
+        np.random.randn(V * S, D, D).astype(np.float32) * 0.3)}
+    x = jnp.asarray(np.random.randn(8, D).astype(np.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def pipelined_loss(params):
+        y = parallel.pipeline_apply_interleaved(
+            stage_fn, params, x, mesh=mesh, num_microbatches=8)
+        return jnp.sum(y ** 2)
+
+    def sequential_loss(params):
+        h = x
+        for i in range(V * S):
+            h = jnp.tanh(h @ params["w"][i])
+        return jnp.sum(h ** 2)
+
+    g_pipe = jax.grad(pipelined_loss)(stacked)
+    g_seq = jax.grad(sequential_loss)(stacked)
+    np.testing.assert_allclose(np.asarray(g_pipe["w"]),
+                               np.asarray(g_seq["w"]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_interleaved_trainer_matches_unpipelined():
+    """PipelineTrainer(schedule='interleaved') with 2S stages: one step
+    equals the plain sequential reference; sync_to_net un-permutes the
+    device-major storage back to natural stage order."""
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(22)
+    mx.random.seed(22)
+    S, V, D = 4, 2, 8
+    stages = _make_stages(V * S, D)
+    w_nat = [st.weight.data().asnumpy().copy() for st in stages]
+    b_nat = [st.bias.data().asnumpy().copy() for st in stages]
+    pt = parallel.PipelineTrainer(
+        stages, gluon.loss.L2Loss(), "sgd", {"learning_rate": 0.1},
+        mesh=_pipe_mesh(S), data_axis=None, donate=False,
+        schedule="interleaved")
+
+    x = np.random.RandomState(23).rand(8, D).astype(np.float32)
+    y = np.random.RandomState(24).rand(8, D).astype(np.float32)
+    loss = float(pt.step(x, y))
+
+    def ref_loss(params):
+        h = jnp.asarray(x)
+        for w, b in zip(params["w"], params["b"]):
+            h = jnp.tanh(h @ w.T + b)
+        return jnp.mean((h - jnp.asarray(y)) ** 2 / 2.0)
+
+    p0 = {"w": [jnp.asarray(w) for w in w_nat],
+          "b": [jnp.asarray(b) for b in b_nat]}
+    ref_l, g = jax.value_and_grad(ref_loss)(p0)
+    assert abs(loss - float(ref_l)) < 1e-5
+    pt.sync_to_net()
+    for i, st in enumerate(stages):
+        np.testing.assert_allclose(
+            st.weight.data().asnumpy(),
+            w_nat[i] - 0.1 * np.asarray(g["w"][i]),
+            rtol=1e-5, atol=1e-6, err_msg=f"stage {i}")
 
 
 def test_pipeline_microbatch_data_axis_divisibility_error():
