@@ -73,6 +73,25 @@ def _timed_steps(trainer, args):
     return time.perf_counter() - t0
 
 
+# measured MXU ceiling through this tunnel (PROFILE.md 8192^3 matmul
+# chain); nominal v5e is ~197 TF/s bf16
+CEILING_TFS = float(os.environ.get("MXTPU_BENCH_CEILING_TFS", "122.8"))
+
+
+def _tfs(trainer, args, dt, n_dev):
+    """Realized TF/s/chip for the step from XLA's own cost analysis
+    (VERDICT r4 item 2: MFU accounting for every config, no hand
+    formulas). None when the backend doesn't expose cost analysis.
+    cost_analysis() reports PER-DEVICE flops after SPMD partitioning
+    (verified on a 4-device mesh), so no /n_dev here — dt is also
+    per-step wall time shared by all chips."""
+    del n_dev
+    flops = trainer.step_cost_analysis(*args)
+    if not flops:
+        return None
+    return flops * ITERS / dt / 1e12
+
+
 def bench_mlp():
     """config[0]: Gluon MLP / MNIST.
 
@@ -115,7 +134,8 @@ def bench_mlp():
     float(jax.device_get(loss))
     dt = time.perf_counter() - t0
     return (batch * ITERS / dt / n_dev, "images/sec/chip",
-            "mlp_mnist_train_throughput_per_chip", "mlp")
+            "mlp_mnist_train_throughput_per_chip", "mlp",
+            _tfs(trainer, (x, y), dt, n_dev))
 
 
 def bench_lstm_ptb():
@@ -146,7 +166,8 @@ def bench_lstm_ptb():
     y = _place(mesh, data[:, 1:].astype(np.float32))
     dt = _timed_steps(trainer, (x, y))
     return (B * T * ITERS / dt / n_dev, "tokens/sec/chip",
-            "lstm_ptb_train_throughput_per_chip", "lstm_ptb")
+            "lstm_ptb_train_throughput_per_chip", "lstm_ptb",
+            _tfs(trainer, (x, y), dt, n_dev))
 
 
 def bench_bert():
@@ -184,7 +205,8 @@ def bench_bert():
     nsp_y = _place(mesh, np.random.randint(0, 2, (B,)).astype(np.float32))
     dt = _timed_steps(trainer, ([tok, seg, vl], [mlm_y, nsp_y]))
     return (B * ITERS / dt / n_dev, "sequences/sec/chip",
-            "bert_base_pretrain_throughput_per_chip", "bert_base")
+            "bert_base_pretrain_throughput_per_chip", "bert_base",
+            _tfs(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), dt, n_dev))
 
 
 def bench_ssd():
@@ -231,7 +253,8 @@ def bench_ssd():
     y = _place(mesh, label)
     dt = _timed_steps(trainer, (x, y))
     return (B * ITERS / dt / n_dev, "images/sec/chip",
-            "ssd300_train_throughput_per_chip", "ssd300")
+            "ssd300_train_throughput_per_chip", "ssd300",
+            _tfs(trainer, (x, y), dt, n_dev))
 
 
 def bench_resnet():
@@ -259,7 +282,8 @@ def bench_resnet():
     y = _place(mesh, np.random.randint(0, 1000, (batch,)).astype(np.float32))
     dt = _timed_steps(trainer, (x, y))
     return (batch * ITERS / dt / n_dev, "images/sec/chip",
-            "resnet50_v1_train_throughput_per_chip", "resnet50")
+            "resnet50_v1_train_throughput_per_chip", "resnet50",
+            _tfs(trainer, (x, y), dt, n_dev))
 
 
 CONFIGS = {
@@ -277,13 +301,17 @@ def run_one(key):
     """Run a single config in-process; print its JSON line to stdout."""
     fn = CONFIGS[key]
     try:
-        value, unit, metric, _ = fn()
-        print(json.dumps({
+        value, unit, metric, _, tfs = fn()
+        line = {
             "metric": metric,
             "value": round(value, 2),
             "unit": unit,
             "vs_baseline": round(value / ANCHORS[key], 4),
-        }), flush=True)
+        }
+        if tfs:
+            line["tfs"] = round(tfs, 2)
+            line["mfu_pct"] = round(100.0 * tfs / CEILING_TFS, 1)
+        print(json.dumps(line), flush=True)
         return 0
     except Exception as e:
         print(json.dumps({

@@ -1,0 +1,97 @@
+// C++ checkpoint round-trip through the C ABI (round 5 — the
+// "run the checkpoint side of a model from C" slice of the reference's
+// MXNDArrayLoad/MXNDArraySave C API, src/c_api/c_api.cc).
+//
+// Reads a .params checkpoint (written by mx.nd.save / gluon
+// save_parameters), reports every tensor, applies an SGD-shaped update
+// (w <- w * (1 - eps)) to all float32 tensors in pure C++, writes the
+// result as a new .params the Python side loads back, and writes a
+// RecordIO stream of the tensor names with the native writer (read back
+// by either the C prefetch reader or Python MXRecordIO).
+//
+// Build + run: make -C examples/cpp mxtpu_params_demo &&
+//   examples/cpp/mxtpu_params_demo <in.params> <out.params> <out.rec>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* mxio_params_open(const char* path);
+int mxio_params_count(void* h);
+const char* mxio_params_name(void* h, int i);
+const char* mxio_params_descr(void* h, int i);
+int mxio_params_info(void* h, int i, int* dtype, int64_t* shape,
+                     int max_ndim, int64_t* nbytes);
+int64_t mxio_params_read(void* h, int i, void* out, int64_t cap);
+void mxio_params_close(void* h);
+void* mxio_params_writer_open(const char* path);
+int mxio_params_writer_add(void* h, const char* name, int dtype, int ndim,
+                           const int64_t* shape, const void* data);
+int mxio_params_writer_close(void* h);
+void* mxio_recwriter_open(const char* path);
+int mxio_recwriter_write(void* h, const uint8_t* data, size_t len);
+int mxio_recwriter_close(void* h);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s <in.params> <out.params> <out.rec>\n",
+                 argv[0]);
+    return 2;
+  }
+  void* h = mxio_params_open(argv[1]);
+  if (!h) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  void* w = mxio_params_writer_open(argv[2]);
+  void* rec = mxio_recwriter_open(argv[3]);
+  if (!w || !rec) {
+    std::fprintf(stderr, "cannot open outputs\n");
+    return 1;
+  }
+  const int n = mxio_params_count(h);
+  std::printf("checkpoint %s: %d tensors\n", argv[1], n);
+  int rc = 0;
+  for (int i = 0; i < n; ++i) {
+    const char* name = mxio_params_name(h, i);
+    int dtype = -1;
+    int64_t shape[32];
+    int64_t nbytes = 0;
+    int ndim = mxio_params_info(h, i, &dtype, shape, 32, &nbytes);
+    if (ndim < 0) { rc = 1; break; }
+    std::vector<uint8_t> buf(static_cast<size_t>(nbytes));
+    if (mxio_params_read(h, i, buf.data(), nbytes) != nbytes) {
+      rc = 1; break;
+    }
+    if (i < 4) {
+      std::printf("  %-40s dtype=%d (%s) shape=(", name, dtype,
+                  mxio_params_descr(h, i));
+      for (int d = 0; d < ndim; ++d)
+        std::printf("%lld%s", static_cast<long long>(shape[d]),
+                    d + 1 < ndim ? ", " : "");
+      std::printf(") %lld bytes\n", static_cast<long long>(nbytes));
+    }
+    if (dtype == 0) {  // float32: the C++-side "update"
+      float* f = reinterpret_cast<float*>(buf.data());
+      for (int64_t k = 0; k < nbytes / 4; ++k) f[k] *= 0.5f;
+    }
+    if (mxio_params_writer_add(w, name, dtype, ndim, shape,
+                               buf.data()) != 0) {
+      rc = 1; break;
+    }
+    if (mxio_recwriter_write(
+            rec, reinterpret_cast<const uint8_t*>(name),
+            std::strlen(name)) != 0) {
+      rc = 1; break;
+    }
+  }
+  mxio_params_close(h);
+  if (mxio_params_writer_close(w) != 0) rc = 1;
+  if (mxio_recwriter_close(rec) != 0) rc = 1;
+  std::printf(rc == 0 ? "wrote %s + %s\n" : "FAILED\n", argv[2], argv[3]);
+  return rc;
+}

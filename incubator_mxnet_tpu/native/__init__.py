@@ -75,8 +75,116 @@ def lib() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_size_t), ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    # checkpoint (.params/.npz) + RecordIO-writer C ABI (round 5)
+    l.mxio_params_open.restype = ctypes.c_void_p
+    l.mxio_params_open.argtypes = [ctypes.c_char_p]
+    l.mxio_params_count.argtypes = [ctypes.c_void_p]
+    l.mxio_params_name.restype = ctypes.c_char_p
+    l.mxio_params_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    l.mxio_params_descr.restype = ctypes.c_char_p
+    l.mxio_params_descr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    l.mxio_params_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64)]
+    l.mxio_params_read.restype = ctypes.c_int64
+    l.mxio_params_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_void_p, ctypes.c_int64]
+    l.mxio_params_close.argtypes = [ctypes.c_void_p]
+    l.mxio_params_writer_open.restype = ctypes.c_void_p
+    l.mxio_params_writer_open.argtypes = [ctypes.c_char_p]
+    l.mxio_params_writer_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p]
+    l.mxio_params_writer_close.argtypes = [ctypes.c_void_p]
+    l.mxio_recwriter_open.restype = ctypes.c_void_p
+    l.mxio_recwriter_open.argtypes = [ctypes.c_char_p]
+    l.mxio_recwriter_write.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+    l.mxio_recwriter_close.argtypes = [ctypes.c_void_p]
     _LIB = l
     return _LIB
+
+
+# reference mshadow TypeFlag codes <-> numpy (native checkpoint ABI)
+_DTYPE_CODES = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 7}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def native_params_load(path: str):
+    """Read a ``.params``/``.npz`` checkpoint through the C ABI (tests the
+    non-Python consumer path; Python callers normally use ``nd.load``).
+    Returns ``{name: np.ndarray}``."""
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        raise RuntimeError("native IO library unavailable")
+    h = l.mxio_params_open(path.encode())
+    if not h:
+        raise IOError(f"native open failed: {path}")
+    try:
+        out = {}
+        for i in range(l.mxio_params_count(h)):
+            name = l.mxio_params_name(h, i).decode()
+            dt = ctypes.c_int()
+            nb = ctypes.c_int64()
+            shape = (ctypes.c_int64 * 32)()
+            ndim = l.mxio_params_info(h, i, ctypes.byref(dt), shape, 32,
+                                      ctypes.byref(nb))
+            if ndim < 0 or dt.value not in _CODE_DTYPES:
+                raise IOError(
+                    f"{name}: unsupported entry (ndim={ndim}, "
+                    f"descr={l.mxio_params_descr(h, i).decode()!r})")
+            # C ABI contract: reads are always C-order (the native layer
+            # transposes fortran_order members itself)
+            buf = (ctypes.c_uint8 * max(nb.value, 1))()
+            if l.mxio_params_read(h, i, buf, nb.value) != nb.value:
+                raise IOError(f"{name}: short read")
+            if dt.value == 7:
+                import ml_dtypes
+
+                npdt = ml_dtypes.bfloat16
+            else:
+                npdt = np.dtype(_CODE_DTYPES[dt.value])
+            # string_at: one memcpy out of the ctypes buffer (slicing a
+            # c_uint8 array would box every byte into a Python int)
+            out[name] = np.frombuffer(
+                ctypes.string_at(buf, nb.value), npdt).reshape(
+                tuple(shape[:ndim])).copy()
+        return out
+    finally:
+        l.mxio_params_close(h)
+
+
+def native_params_save(path: str, arrays) -> None:
+    """Write ``{name: np.ndarray}`` as a ``.params`` checkpoint through
+    the C ABI — byte-compatible with ``nd.load`` and ``numpy.load``."""
+    import numpy as np
+
+    l = lib()
+    if l is None:
+        raise RuntimeError("native IO library unavailable")
+    h = l.mxio_params_writer_open(path.encode())
+    if not h:
+        raise IOError(f"native writer open failed: {path}")
+    ok = True
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        code = _DTYPE_CODES.get(arr.dtype.name)
+        if code is None:
+            ok = False
+            break
+        shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+        if l.mxio_params_writer_add(
+                h, name.encode(), code, arr.ndim, shape,
+                arr.ctypes.data_as(ctypes.c_void_p)) != 0:
+            ok = False
+            break
+    rc = l.mxio_params_writer_close(h)
+    if not ok or rc != 0:
+        raise IOError(f"native params write failed: {path}")
 
 
 class NativeRecordReader:
@@ -110,6 +218,42 @@ class NativeRecordReader:
     def close(self) -> None:
         if self._h:
             self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    """RecordIO writer over the native library (dmlc framing —
+    interchangeable with ``recordio.MXRecordIO`` and the C reader)."""
+
+    def __init__(self, path: str):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = l
+        self._h = l.mxio_recwriter_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, record: bytes) -> None:
+        import numpy as np
+
+        buf = np.frombuffer(record, np.uint8)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) \
+            if len(record) else ctypes.POINTER(ctypes.c_uint8)()
+        if self._lib.mxio_recwriter_write(self._h, ptr, len(record)) != 0:
+            raise IOError("RecordIO write failed")
+
+    def close(self) -> None:
+        if self._h:
+            if self._lib.mxio_recwriter_close(self._h) != 0:
+                self._h = None
+                raise IOError("RecordIO close failed")
             self._h = None
 
     def __del__(self):

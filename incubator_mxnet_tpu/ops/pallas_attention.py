@@ -455,20 +455,25 @@ def _flash_core_bwd(scale, causal, interpret, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _use_pallas_path(tq, tk, interpret):
+def _use_pallas_path(b, h, tq, tk, interpret):
     """Size-aware algo selection (the cuDNN-autotune-registry analog).
 
     An explicit ``interpret=`` pins the Pallas path (tests exercise the
     kernels at tiny shapes that way). Otherwise sequences below the
     measured crossover (``MXTPU_FLASH_MIN_SEQ``, default 2048 — PROFILE.md:
     Pallas backward is 0.47x XLA at T=1024 but 1.8x/4.7x at 2048/4096)
-    take the XLA dense path in both directions."""
+    take the XLA dense path in both directions — UNLESS the dense f32
+    score tensor it materialises would exceed 1 GiB, where the flash
+    kernel's O(T) memory wins regardless of speed (a huge-B*H job at
+    T<2048 must never OOM because of a speed heuristic)."""
     if interpret is not None:
         return True
     from ..config import config
 
     min_seq = int(config.get("MXTPU_FLASH_MIN_SEQ"))
-    return min_seq <= 0 or max(tq, tk) >= min_seq
+    if min_seq <= 0 or max(tq, tk) >= min_seq:
+        return True
+    return b * h * tq * tk * 4 > (1 << 30)
 
 
 @register("flash_attention")
@@ -484,7 +489,8 @@ def flash_attention(q, k, v, lengths=None, scale=None, causal=False,
     registry picks an algo per shape."""
     d = q.shape[-1]
     s = float(scale) if scale is not None else 1.0 / (d ** 0.5)
-    if not _use_pallas_path(q.shape[2], k.shape[2], interpret):
+    if not _use_pallas_path(q.shape[0], q.shape[1], q.shape[2],
+                            k.shape[2], interpret):
         return _xla_reference(q, k, v, lengths, s, bool(causal))
     if interpret is None:
         interpret = not pallas_available()

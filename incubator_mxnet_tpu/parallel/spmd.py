@@ -298,6 +298,36 @@ class SPMDTrainer:
                 label_arrays)
         return loss
 
+    def step_cost_analysis(self, data, labels):
+        """XLA's own cost model for the fused train-step executable:
+        returns the per-step ``flops`` estimate (float, model+optimizer,
+        fwd+bwd) or ``None`` where the PJRT backend doesn't expose cost
+        analysis. Used by ``bench.py`` for MFU accounting — one source of
+        truth instead of hand-maintained per-model FLOP formulas."""
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        data_arrays = [jax.device_put(self._as_jax(d), self._batch_sharding)
+                       for d in data]
+        label_arrays = [jax.device_put(self._as_jax(l),
+                                       self._batch_sharding)
+                        for l in labels]
+        fn = self._jit_step(len(data_arrays), len(label_arrays))
+        from .mesh import mesh_scope
+
+        try:
+            with mesh_scope(self.mesh):
+                compiled = fn.lower(
+                    self.params, self.frozen, self.opt_state,
+                    jax.random.PRNGKey(0), data_arrays,
+                    label_arrays).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):   # one dict per device
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            return flops or None
+        except Exception:
+            return None
+
     def run_steps(self, n: int, data, labels) -> float:
         """Run ``n`` fused steps ON DEVICE in one dispatch (a
         ``lax.fori_loop`` over the step body, per-iteration rng derived

