@@ -42,7 +42,8 @@ ANCHORS = {
 }
 
 WARMUP = 3
-ITERS = 10
+ITERS = 10          # short window
+ITERS2 = 30         # long window (two-point fit)
 
 
 def _place(mesh, arr, dtype=None):
@@ -56,9 +57,19 @@ def _place(mesh, arr, dtype=None):
 
 
 def _timed_steps(trainer, args):
-    """warmup + timed loop; returns wall seconds for ITERS steps.
-    device_get forces a full roundtrip — the experimental PJRT tunnel's
-    block_until_ready is not a reliable fence."""
+    """warmup + TWO timed windows (ITERS and ITERS2 steps, one fence
+    each); returns per-step seconds from the linear fit
+    ``(t2 - t1) / (ITERS2 - ITERS)``.
+
+    Round-5 methodology fix: a device_get fence through the experimental
+    PJRT tunnel costs a FIXED ~60-100 ms regardless of how much work it
+    fences (measured, PROFILE.md "fence artifact"), so the old
+    single-window number was ``S + fence/ITERS`` — a ~10-20%%
+    understatement of steady-state step time. The two-point fit cancels
+    the fixed term exactly; steady-state throughput is also what the
+    reference's async engine delivers (it never fences per step) and
+    what the BASELINE anchors measured. Falls back to the long-window
+    mean if tunnel variance makes the fit non-positive."""
     import jax
 
     loss = trainer.step(*args)
@@ -66,30 +77,46 @@ def _timed_steps(trainer, args):
     for _ in range(WARMUP - 1):
         loss = trainer.step(*args)
     float(jax.device_get(loss))
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = trainer.step(*args)
-    float(jax.device_get(loss))
-    return time.perf_counter() - t0
+
+    def window(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = trainer.step(*args)
+        float(jax.device_get(loss))
+        return time.perf_counter() - t0
+
+    return _fit_windows(window)
 
 
-# measured MXU ceiling through this tunnel (PROFILE.md 8192^3 matmul
-# chain); nominal v5e is ~197 TF/s bf16
-CEILING_TFS = float(os.environ.get("MXTPU_BENCH_CEILING_TFS", "122.8"))
+def _fit_windows(window):
+    """Slope of t(n) at n=ITERS vs n=ITERS2 — cancels the fixed fence
+    term; falls back to the long-window mean if variance flips the fit."""
+    t1 = window(ITERS)
+    t2 = window(ITERS2)
+    per = (t2 - t1) / (ITERS2 - ITERS)
+    if per <= 0:          # tunnel variance swamped the fit
+        per = t2 / ITERS2
+    return per
 
 
-def _tfs(trainer, args, dt, n_dev):
+# measured MXU ceiling: 187.9 TF/s via fence-free two-point-fit timing
+# of an 8192^3 bf16 matmul chain (PROFILE.md round 5 — the old 122.8
+# figure carried the fixed fence cost); nominal v5e ~197 TF/s bf16
+CEILING_TFS = float(os.environ.get("MXTPU_BENCH_CEILING_TFS", "187.9"))
+
+
+def _tfs(trainer, args, per, n_dev):
     """Realized TF/s/chip for the step from XLA's own cost analysis
     (VERDICT r4 item 2: MFU accounting for every config, no hand
     formulas). None when the backend doesn't expose cost analysis.
     cost_analysis() reports PER-DEVICE flops after SPMD partitioning
-    (verified on a 4-device mesh), so no /n_dev here — dt is also
-    per-step wall time shared by all chips."""
+    (verified on a 4-device mesh), so no /n_dev here — ``per`` is
+    per-step wall seconds shared by all chips."""
     del n_dev
     flops = trainer.step_cost_analysis(*args)
     if not flops:
         return None
-    return flops * ITERS / dt / 1e12
+    return flops / per / 1e12
 
 
 def bench_mlp():
@@ -126,16 +153,22 @@ def bench_mlp():
     x = _place(mesh, np.random.rand(batch, 784).astype(np.float32),
                jnp.bfloat16)
     y = _place(mesh, np.random.randint(0, 10, (batch,)).astype(np.float32))
-    # warm with the SAME n — run_steps caches its jitted loop per n, so a
-    # different warmup count would put trace+compile inside the window
+    # warm BOTH loop sizes — run_steps caches its jitted loop per n, so
+    # an unwarmed n would put trace+compile inside its window; then the
+    # two-point fit cancels the fixed fence cost (see _timed_steps)
     float(jax.device_get(trainer.run_steps(ITERS, x, y)))
-    t0 = time.perf_counter()
-    loss = trainer.run_steps(ITERS, x, y)
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-    return (batch * ITERS / dt / n_dev, "images/sec/chip",
+    float(jax.device_get(trainer.run_steps(ITERS2, x, y)))
+
+    def window(n):
+        t0 = time.perf_counter()
+        loss = trainer.run_steps(n, x, y)
+        float(jax.device_get(loss))
+        return time.perf_counter() - t0
+
+    per = _fit_windows(window)
+    return (batch / per / n_dev, "images/sec/chip",
             "mlp_mnist_train_throughput_per_chip", "mlp",
-            _tfs(trainer, (x, y), dt, n_dev))
+            _tfs(trainer, (x, y), per, n_dev))
 
 
 def bench_lstm_ptb():
@@ -164,10 +197,10 @@ def bench_lstm_ptb():
     data = np.random.randint(0, V, (B, T + 1))
     x = _place(mesh, data[:, :-1].astype(np.int32))
     y = _place(mesh, data[:, 1:].astype(np.float32))
-    dt = _timed_steps(trainer, (x, y))
-    return (B * T * ITERS / dt / n_dev, "tokens/sec/chip",
+    per = _timed_steps(trainer, (x, y))
+    return (B * T / per / n_dev, "tokens/sec/chip",
             "lstm_ptb_train_throughput_per_chip", "lstm_ptb",
-            _tfs(trainer, (x, y), dt, n_dev))
+            _tfs(trainer, (x, y), per, n_dev))
 
 
 def bench_bert():
@@ -203,10 +236,10 @@ def bench_bert():
     vl = _place(mesh, np.full((B,), T, np.int32))
     mlm_y = _place(mesh, np.random.randint(0, V, (B, T)).astype(np.float32))
     nsp_y = _place(mesh, np.random.randint(0, 2, (B,)).astype(np.float32))
-    dt = _timed_steps(trainer, ([tok, seg, vl], [mlm_y, nsp_y]))
-    return (B * ITERS / dt / n_dev, "sequences/sec/chip",
+    per = _timed_steps(trainer, ([tok, seg, vl], [mlm_y, nsp_y]))
+    return (B / per / n_dev, "sequences/sec/chip",
             "bert_base_pretrain_throughput_per_chip", "bert_base",
-            _tfs(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), dt, n_dev))
+            _tfs(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), per, n_dev))
 
 
 def bench_ssd():
@@ -251,10 +284,10 @@ def bench_ssd():
         label[i, 0] = [rs.randint(20), cx - w / 2, cy - h / 2,
                        cx + w / 2, cy + h / 2]
     y = _place(mesh, label)
-    dt = _timed_steps(trainer, (x, y))
-    return (B * ITERS / dt / n_dev, "images/sec/chip",
+    per = _timed_steps(trainer, (x, y))
+    return (B / per / n_dev, "images/sec/chip",
             "ssd300_train_throughput_per_chip", "ssd300",
-            _tfs(trainer, (x, y), dt, n_dev))
+            _tfs(trainer, (x, y), per, n_dev))
 
 
 def bench_resnet():
@@ -280,10 +313,10 @@ def bench_resnet():
     x = _place(mesh, np.random.rand(batch, 3, 224, 224).astype(np.float32),
                jnp.bfloat16)
     y = _place(mesh, np.random.randint(0, 1000, (batch,)).astype(np.float32))
-    dt = _timed_steps(trainer, (x, y))
-    return (batch * ITERS / dt / n_dev, "images/sec/chip",
+    per = _timed_steps(trainer, (x, y))
+    return (batch / per / n_dev, "images/sec/chip",
             "resnet50_v1_train_throughput_per_chip", "resnet50",
-            _tfs(trainer, (x, y), dt, n_dev))
+            _tfs(trainer, (x, y), per, n_dev))
 
 
 CONFIGS = {

@@ -60,9 +60,10 @@ def main():
         return jnp.mean((h - y) ** 2)
 
     print(f"S={S} D={D} mb_size={args.mb_size} "
-          f"(fixed microbatch size; batch grows with M)")
-    print(f"{'M':>4} {'gpipe ms':>9} {'1f1b ms':>9} {'ms/mb g':>8} "
-          f"{'ms/mb f':>8} {'bubble%':>8}")
+          f"(fixed microbatch size; batch grows with M; gpipe2/inter = "
+          f"the SAME 2S-layer model, 2-layer stages vs V=2 interleaved)")
+    print(f"{'M':>4} {'gpipe ms':>9} {'1f1b ms':>9} {'gpipe2 ms':>9} "
+          f"{'inter ms':>9} {'bubble%':>8} {'i-bubble%':>9}")
     for M in (S, 2 * S, 4 * S, 8 * S):
         B = args.mb_size * M
         x = jnp.asarray(rs.randn(B, D).astype(np.float32))
@@ -78,8 +79,34 @@ def main():
             stage_fn, p, x, y, per_mb_loss, mesh=mesh,
             num_microbatches=M))
 
+        # interleaved vs 2-layer-per-stage GPipe: SAME 2S-layer model on
+        # the same S devices — GPipe fuses 2 layers per tick, the
+        # interleaved schedule runs V=2 single-layer chunks per device
+        # (bubble (S-1)/(MV+S-1), half of GPipe's relative bubble)
+        stacked_v = {"w": jnp.asarray(
+            rs.randn(2 * S, D, D).astype(np.float32) * 0.1)}
+        stacked_2 = {"w": stacked_v["w"].reshape(S, 2, D, D)}
+
+        def stage2_fn(p, h):
+            return jnp.tanh(jnp.tanh(h @ p["w"][0]) @ p["w"][1])
+
+        def loss_gpipe2(params):
+            out = parallel.pipeline_apply(stage2_fn, params, x, mesh=mesh,
+                                          num_microbatches=M)
+            return jnp.mean((out - y) ** 2)
+
+        def loss_inter(params):
+            out = parallel.pipeline_apply_interleaved(
+                stage_fn, params, x, mesh=mesh, num_microbatches=M)
+            return jnp.mean((out - y) ** 2)
+
+        g_gpipe2 = jax.jit(jax.value_and_grad(loss_gpipe2))
+        g_inter = jax.jit(jax.value_and_grad(loss_inter))
+
         res = {}
-        for name, fn in (("gpipe", g_gpipe), ("1f1b", f_1f1b)):
+        for name, fn in (("gpipe", g_gpipe), ("1f1b", f_1f1b),
+                         ("gpipe2", lambda _: g_gpipe2(stacked_2)),
+                         ("inter", lambda _: g_inter(stacked_v))):
             out = fn(stacked)
             jax.block_until_ready(out)
             t0 = time.perf_counter()
@@ -88,9 +115,10 @@ def main():
             jax.block_until_ready(out)
             res[name] = (time.perf_counter() - t0) / args.iters * 1e3
         bubble = 100.0 * (S - 1) / (M + S - 1)
+        ibubble = 100.0 * (S - 1) / (M * 2 + S - 1)
         print(f"{M:4d} {res['gpipe']:9.2f} {res['1f1b']:9.2f} "
-              f"{res['gpipe'] / M:8.3f} {res['1f1b'] / M:8.3f} "
-              f"{bubble:8.1f}", flush=True)
+              f"{res['gpipe2']:9.2f} {res['inter']:9.2f} "
+              f"{bubble:8.1f} {ibubble:9.1f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -228,7 +228,12 @@ int main(int argc, char** argv) {
     return 1;
   }
   typedef const PJRT_Api* (*GetApiFn)(void);
-  g_api = reinterpret_cast<GetApiFn>(dlsym(so, "GetPjrtApi"))();
+  GetApiFn get_api = reinterpret_cast<GetApiFn>(dlsym(so, "GetPjrtApi"));
+  if (!get_api) {
+    std::fprintf(stderr, "GetPjrtApi not exported: %s\n", dlerror());
+    return 1;
+  }
+  g_api = get_api();
   std::printf("PJRT api %d.%d\n", g_api->pjrt_api_version.major_version,
               g_api->pjrt_api_version.minor_version);
 

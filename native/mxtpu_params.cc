@@ -196,6 +196,12 @@ void* mxio_params_open(const char* path) {
   if (n_entries == 0xFFFF || cd_off == 0xFFFFFFFFu) {  // ZIP64
     delete pf; std::fclose(f); return nullptr;
   }
+  // corrupt EOCD sanity: the directory must lie inside the file, or the
+  // vector below would throw bad_alloc across the C boundary
+  if (static_cast<uint64_t>(zip_base) + cd_off + cd_size >
+      static_cast<uint64_t>(fsize)) {
+    delete pf; std::fclose(f); return nullptr;
+  }
   std::vector<uint8_t> cd(cd_size);
   std::fseek(f, static_cast<long>(zip_base + cd_off), SEEK_SET);
   if (std::fread(cd.data(), 1, cd_size, f) != cd_size) {
@@ -378,6 +384,14 @@ int mxio_params_writer_add(void* h, const char* name, int dtype, int ndim,
   std::string member = std::string(name) + ".npy";
   size_t total = npy.size() + nbytes;
   if (total >= 0xFFFFFFFFu || w->count == 0xFFFE) return 1;  // needs ZIP64
+  // cumulative offset must also fit the 32-bit local-header-offset
+  // fields — fail loudly instead of writing wrapped offsets
+  long cur = std::ftell(w->f);
+  if (cur < 0 ||
+      static_cast<uint64_t>(cur) + total + 128 >= 0xFFFFFFFFu) {
+    w->ok = false;
+    return 1;
+  }
   uint32_t crc = Crc32(reinterpret_cast<const uint8_t*>(npy.data()),
                        npy.size());
   crc = Crc32(static_cast<const uint8_t*>(data), nbytes, crc);

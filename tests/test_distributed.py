@@ -45,8 +45,8 @@ def test_launcher_runs_dist_kvstore_workers(n):
         assert f"RANK {rank}/{n} OK" in proc.stdout
 
 
-def test_weak_scaling_curve_4procs():
-    """VERDICT r4 item 7: 4 procs x 2 devices weak scaling of the
+def test_weak_scaling_curve_8procs():
+    """VERDICT r4 item 7 + r5: up to 8 procs x 2 devices weak scaling of the
     compiled cross-process collective path. Records the curve; asserts
     the 4-proc step stays within a sane factor of 1-proc (localhost CPU
     collectives — correctness + trend evidence, not ICI bandwidth)."""
@@ -74,8 +74,10 @@ def test_weak_scaling_curve_4procs():
     print("weak-scaling:", results)
     # weak scaling: per-process work fixed; generous slack — this host
     # reports ONE core, so >1 proc measures scheduler oversubscription
-    # (docs/SCALING.md); the assert only guards against pathological
-    # collapse of the compiled-collective path
+    # (docs/SCALING.md); the asserts only guard against pathological
+    # collapse of the compiled-collective path at any point
+    assert results[4]["train_step_ms"] < 10 * results[1]["train_step_ms"], \
+        results
     assert results[8]["train_step_ms"] < 30 * results[1]["train_step_ms"], \
         results
 
