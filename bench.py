@@ -88,6 +88,25 @@ def _timed_steps(trainer, args):
     return _fit_windows(window)
 
 
+def _run_steps_fit(trainer, x, y):
+    """Two-point fit over ``run_steps`` windows (on-device loop, one
+    dispatch each). Warms BOTH loop sizes first — run_steps caches its
+    jitted loop per n, so an unwarmed n would put trace+compile inside
+    its window."""
+    import jax
+
+    float(jax.device_get(trainer.run_steps(ITERS, x, y)))
+    float(jax.device_get(trainer.run_steps(ITERS2, x, y)))
+
+    def window(n):
+        t0 = time.perf_counter()
+        loss = trainer.run_steps(n, x, y)
+        float(jax.device_get(loss))
+        return time.perf_counter() - t0
+
+    return _fit_windows(window)
+
+
 def _fit_windows(window):
     """Slope of t(n) at n=ITERS vs n=ITERS2 — cancels the fixed fence
     term; falls back to the long-window mean if variance flips the fit."""
@@ -153,19 +172,7 @@ def bench_mlp():
     x = _place(mesh, np.random.rand(batch, 784).astype(np.float32),
                jnp.bfloat16)
     y = _place(mesh, np.random.randint(0, 10, (batch,)).astype(np.float32))
-    # warm BOTH loop sizes — run_steps caches its jitted loop per n, so
-    # an unwarmed n would put trace+compile inside its window; then the
-    # two-point fit cancels the fixed fence cost (see _timed_steps)
-    float(jax.device_get(trainer.run_steps(ITERS, x, y)))
-    float(jax.device_get(trainer.run_steps(ITERS2, x, y)))
-
-    def window(n):
-        t0 = time.perf_counter()
-        loss = trainer.run_steps(n, x, y)
-        float(jax.device_get(loss))
-        return time.perf_counter() - t0
-
-    per = _fit_windows(window)
+    per = _run_steps_fit(trainer, x, y)
     return (batch / per / n_dev, "images/sec/chip",
             "mlp_mnist_train_throughput_per_chip", "mlp",
             _tfs(trainer, (x, y), per, n_dev))
@@ -173,7 +180,13 @@ def bench_mlp():
 
 def bench_lstm_ptb():
     """config[3]: LSTM PTB medium (2x650, seq 35, batch 20) — the cuDNN-RNN
-    capability over lax.scan."""
+    capability over lax.scan.
+
+    Round 5: drives ``run_steps`` (on-device loop, one dispatch per
+    window) like the MLP config — a PTB step is a few ms of scan-heavy
+    compute, so per-step host dispatch through the tunnel was a
+    material fraction of the old number; the reference's async engine
+    pipelines step dispatch identically."""
     import jax
 
     import incubator_mxnet_tpu as mx
@@ -197,7 +210,7 @@ def bench_lstm_ptb():
     data = np.random.randint(0, V, (B, T + 1))
     x = _place(mesh, data[:, :-1].astype(np.int32))
     y = _place(mesh, data[:, 1:].astype(np.float32))
-    per = _timed_steps(trainer, (x, y))
+    per = _run_steps_fit(trainer, x, y)
     return (B * T / per / n_dev, "tokens/sec/chip",
             "lstm_ptb_train_throughput_per_chip", "lstm_ptb",
             _tfs(trainer, (x, y), per, n_dev))

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Round-5 ResNet decision measurements, all with fence-cancelling
+two-point-fit timing (PROFILE.md round-5 correction):
+
+  a. true Pallas fused-conv rate per shape vs XLA NCHW (was the r4
+     comparison real or fence artifact?)
+  b. whole-model train step at batch 128 vs 256 (r3's "flat batch
+     scaling" was fence-biased)
+  c. BN use_global_stats ablation (re-validate the ~12 ms stat cost)
+
+Usage: python benchmark/resnet_decision_bench.py [--which a,b,c]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def fit_time(run, n1, n2, reps=2):
+    import jax
+
+    jax.block_until_ready(run(n1))
+    jax.block_until_ready(run(n2))
+
+    def t(n):
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(n))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = t(n1), t(n2)
+    per = (t2 - t1) / (n2 - n1)
+    return (per if per > 0 else t2 / n2), t1 - per * n1
+
+
+def part_a():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from incubator_mxnet_tpu.ops.pallas_conv import fused_conv_bn
+
+    rs = np.random.RandomState(0)
+    shapes = [(64, 64, 56, 3, "l1.c2"), (128, 128, 28, 3, "l2.c2"),
+              (256, 256, 14, 3, "l3.c2"), (512, 512, 7, 3, "l4.c2"),
+              (256, 64, 56, 1, "l1.c1b"), (1024, 256, 14, 1, "l3.c1b")]
+    with jax.default_matmul_precision("default"):
+        for ci, co, hw, k, name in shapes:
+            pad = (k - 1) // 2
+            xh = jnp.asarray(rs.rand(128, hw, hw, ci), jnp.bfloat16)
+            wh = jnp.asarray(rs.rand(k, k, ci, co) * 0.1, jnp.bfloat16)
+            g = jnp.asarray(rs.rand(ci).astype(np.float32) + 0.5)
+            b = jnp.asarray(rs.rand(ci).astype(np.float32))
+
+            def pbody(i, c):
+                y, s, ss = fused_conv_bn(c, wh, g, b, stride=1, pad=pad,
+                                         relu=True, interpret=False)
+                # keep stats alive in the chain (DCE guard) either way
+                upd = ((s[0] + ss[0]) * 1e-20).astype(c.dtype)
+                if ci == co:
+                    return c * 0.9 + y * 1e-6 + upd
+                return c * 0.9 + upd
+
+            prun = jax.jit(
+                lambda kk: lax.fori_loop(0, kk, pbody, xh),
+                static_argnums=0)
+
+            xc = jnp.asarray(rs.rand(128, ci, hw, hw), jnp.bfloat16)
+            wc = jnp.asarray(rs.rand(co, ci, k, k) * 0.1, jnp.bfloat16)
+            dn = lax.conv_dimension_numbers(
+                xc.shape, wc.shape, ("NCHW", "OIHW", "NCHW"))
+            gc = g.reshape(1, ci, 1, 1)
+            bc = b.reshape(1, ci, 1, 1)
+
+            def xbody(i, c):
+                xn = jnp.maximum(c.astype(jnp.float32) * gc + bc, 0.0
+                                 ).astype(c.dtype)
+                y = lax.conv_general_dilated(
+                    xn, wc, (1, 1), [(pad, pad), (pad, pad)],
+                    dimension_numbers=dn)
+                y32 = y.astype(jnp.float32)
+                s = jnp.sum(y32, axis=(0, 2, 3))
+                ss = jnp.sum(y32 * y32, axis=(0, 2, 3))
+                # fold the stats into the carry so XLA cannot DCE the
+                # two reduction passes (review r5: ci==co shapes were
+                # silently dropping them, biasing the comparison)
+                upd = ((s[0] + ss[0]) * 1e-20).astype(c.dtype)
+                if ci == co:
+                    return c * 0.9 + y * 1e-6 + upd
+                return c * 0.9 + upd
+
+            xrun = jax.jit(
+                lambda kk: lax.fori_loop(0, kk, xbody, xc),
+                static_argnums=0)
+
+            fl = 2 * 128 * hw * hw * ci * co * k * k
+            try:
+                pp, _ = fit_time(prun, 10, 40)
+                pal = f"{pp * 1e3:7.3f} ms {fl / pp / 1e12:6.1f} TF/s"
+            except Exception as e:
+                pal = f"FAIL {str(e)[:60]}"
+            xp, _ = fit_time(xrun, 10, 40)
+            print(f"{name:7s} pallas {pal} | xla+bn {xp * 1e3:7.3f} ms "
+                  f"{fl / xp / 1e12:6.1f} TF/s", flush=True)
+
+
+def _trainer(batch, use_global_stats=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, 3, 224, 224), dtype="bfloat16"))
+    if use_global_stats:
+        def freeze(b):
+            if b.__class__.__name__ == "BatchNorm":
+                b._use_global_stats = True
+        net.apply(freeze)
+    mesh = parallel.make_mesh({"data": -1})
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    sh = NamedSharding(mesh, PartitionSpec("data"))
+    rs = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rs.rand(batch, 3, 224, 224),
+                                   jnp.bfloat16), sh)
+    y = jax.device_put(jnp.asarray(rs.randint(0, 1000, (batch,)),
+                                   np.float32), sh)
+    return tr, x, y
+
+
+def _steps_fit(tr, x, y, n1=5, n2=20):
+    import jax
+
+    per, _ = fit_time(
+        lambda n: jax.device_get(tr.run_steps(n, x, y)), n1, n2)
+    return per
+
+
+def part_b():
+    for batch in (128, 256):
+        tr, x, y = _trainer(batch)
+        per = _steps_fit(tr, x, y)
+        print(f"batch {batch}: {per * 1e3:.1f} ms/step "
+              f"{batch / per:.0f} img/s", flush=True)
+        del tr, x, y
+
+
+def part_c():
+    tr, x, y = _trainer(128, use_global_stats=True)
+    per = _steps_fit(tr, x, y)
+    print(f"batch 128 global-stats: {per * 1e3:.1f} ms/step "
+          f"{128 / per:.0f} img/s", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--which", default="a,b,c")
+    args = ap.parse_args()
+    for part in args.which.split(","):
+        {"a": part_a, "b": part_b, "c": part_c}[part]()
+
+
+if __name__ == "__main__":
+    main()
