@@ -107,14 +107,18 @@ def _run_steps_fit(trainer, x, y):
     return _fit_windows(window)
 
 
-def _fit_windows(window):
-    """Slope of t(n) at n=ITERS vs n=ITERS2 — cancels the fixed fence
-    term; falls back to the long-window mean if variance flips the fit."""
-    t1 = window(ITERS)
-    t2 = window(ITERS2)
-    per = (t2 - t1) / (ITERS2 - ITERS)
+def _fit_windows(window, n1=None, n2=None):
+    """Slope of t(n) between two window sizes (default ITERS/ITERS2) —
+    cancels the fixed fence term; falls back to the long-window mean if
+    variance flips the fit. THE one implementation of the round-5
+    fence-cancelling methodology — benchmark/ scripts import it."""
+    n1 = ITERS if n1 is None else n1
+    n2 = ITERS2 if n2 is None else n2
+    t1 = window(n1)
+    t2 = window(n2)
+    per = (t2 - t1) / (n2 - n1)
     if per <= 0:          # tunnel variance swamped the fit
-        per = t2 / ITERS2
+        per = t2 / n2
     return per
 
 

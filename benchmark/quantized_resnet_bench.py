@@ -48,6 +48,10 @@ def main():
     net = vision.resnet50_v1(classes=1000)
     net.initialize(init="xavier")
     net(mx.nd.zeros((2, 3, 224, 224)))   # build + set BN running stats
+    # hybridize for throughput: eager per-op dispatch costs ~100 ms per
+    # op through the tunnel; quantize_model deactivates CachedOps during
+    # calibration and the converted net re-hybridizes lazily after
+    net.hybridize()
 
     rs = np.random.RandomState(1)
 
@@ -62,10 +66,12 @@ def main():
 
     # --- float baseline outputs + throughput ------------------------------
     def run_inference(model, x, iters):
-        """Two-point fit: the tunnel fence costs a fixed ~60-100 ms per
-        window (PROFILE.md round-5 correction), so single-window /iters
-        timing would bias both numbers and push the int8-vs-fp ratio
-        toward 1.0."""
+        """Two-point fit via bench.py's shared `_fit_windows`: the tunnel
+        fence costs a fixed ~60-100 ms per window (PROFILE.md round-5
+        correction), so single-window /iters timing would bias both
+        numbers and push the int8-vs-fp ratio toward 1.0."""
+        from bench import _fit_windows
+
         out = model(x)
         out.asnumpy()
 
@@ -76,11 +82,7 @@ def main():
             o.asnumpy()
             return time.perf_counter() - t0
 
-        t1, t2 = window(iters), window(3 * iters)
-        per = (t2 - t1) / (2 * iters)
-        if per <= 0:
-            per = t2 / (3 * iters)
-        return per, out
+        return _fit_windows(window, iters, 3 * iters), out
 
     x_bench = batch(100, args.batch)
     fp_dt, _ = run_inference(net, x_bench, args.iters)

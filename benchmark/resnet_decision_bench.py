@@ -24,22 +24,29 @@ import numpy as np
 
 
 def fit_time(run, n1, n2, reps=2):
+    """Warm both window sizes, then delegate the slope fit to bench.py's
+    shared `_fit_windows` (one implementation of the fence-cancelling
+    methodology). Returns (per-iter seconds, fence intercept)."""
     import jax
+
+    from bench import _fit_windows
 
     jax.block_until_ready(run(n1))
     jax.block_until_ready(run(n2))
 
-    def t(n):
+    times = {}
+
+    def window(n):
         best = 1e9
         for _ in range(reps):
             t0 = time.perf_counter()
             jax.block_until_ready(run(n))
             best = min(best, time.perf_counter() - t0)
+        times[n] = best
         return best
 
-    t1, t2 = t(n1), t(n2)
-    per = (t2 - t1) / (n2 - n1)
-    return (per if per > 0 else t2 / n2), t1 - per * n1
+    per = _fit_windows(window, n1, n2)
+    return per, times[n1] - per * n1
 
 
 def part_a():
@@ -113,7 +120,7 @@ def part_a():
                   f"{fl / xp / 1e12:6.1f} TF/s", flush=True)
 
 
-def _trainer(batch, use_global_stats=False):
+def _trainer(batch_per_chip, use_global_stats=False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
@@ -122,6 +129,10 @@ def _trainer(batch, use_global_stats=False):
     from incubator_mxnet_tpu import gluon, parallel
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
+    # per-chip convention matching bench.py (batch scales with devices,
+    # throughput reported /chip) so the numbers stay citable next to
+    # BENCH_r0x on any device count
+    batch = batch_per_chip * len(jax.devices())
     net = vision.resnet50_v1(classes=1000)
     net.initialize(init="xavier")
     net.cast("bfloat16")
@@ -153,19 +164,22 @@ def _steps_fit(tr, x, y, n1=5, n2=20):
 
 
 def part_b():
+    import jax
+
+    n_dev = len(jax.devices())
     for batch in (128, 256):
         tr, x, y = _trainer(batch)
         per = _steps_fit(tr, x, y)
-        print(f"batch {batch}: {per * 1e3:.1f} ms/step "
-              f"{batch / per:.0f} img/s", flush=True)
+        print(f"batch {batch}/chip: {per * 1e3:.1f} ms/step "
+              f"{batch / per:.0f} img/s/chip", flush=True)
         del tr, x, y
 
 
 def part_c():
     tr, x, y = _trainer(128, use_global_stats=True)
     per = _steps_fit(tr, x, y)
-    print(f"batch 128 global-stats: {per * 1e3:.1f} ms/step "
-          f"{128 / per:.0f} img/s", flush=True)
+    print(f"batch 128/chip global-stats: {per * 1e3:.1f} ms/step "
+          f"{128 / per:.0f} img/s/chip", flush=True)
 
 
 def main():
