@@ -29,7 +29,14 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--agree-batches", type=int, default=4)
-    ap.add_argument("--calib-mode", default="entropy")
+    # minmax default: on an UNTRAINED net the logit gaps are ~1e-3, so
+    # entropy's tighter thresholds (correct for real outlier-tailed
+    # activations) add enough quantization noise to flip every argmax
+    # (measured: corr 0.9943 but 0/16 agreement vs minmax corr 0.9999,
+    # 16/16). With no trained weights/dataset in this environment,
+    # minmax is the honest agreement probe; entropy's value is shown by
+    # tests/test_quantization_entropy.py on outlier-tailed inputs.
+    ap.add_argument("--calib-mode", default="minmax")
     ap.add_argument("--dtype", default="float32",
                     help="float dtype of the baseline net")
     args = ap.parse_args()
@@ -90,7 +97,8 @@ def main():
           f"{args.batch / fp_dt:9.1f} img/s", flush=True)
 
     agree_x = [batch(200 + i, 64) for i in range(args.agree_batches)]
-    fp_top1 = [net(x).asnumpy().argmax(-1) for x in agree_x]
+    fp_out = [net(x).asnumpy() for x in agree_x]
+    fp_top1 = [o.argmax(-1) for o in fp_out]
 
     # --- quantize ----------------------------------------------------------
     calib = [batch(300 + i, 32) for i in range(4)]
@@ -105,11 +113,16 @@ def main():
           f"{args.batch / q_dt:9.1f} img/s  "
           f"({fp_dt / q_dt:.2f}x vs fp)", flush=True)
 
-    q_top1 = [qnet(x).asnumpy().argmax(-1) for x in agree_x]
+    q_out = [qnet(x).asnumpy() for x in agree_x]
+    q_top1 = [o.argmax(-1) for o in q_out]
     total = sum(a.size for a in fp_top1)
     agree = sum(int((a == b).sum()) for a, b in zip(fp_top1, q_top1))
+    fp_flat = np.concatenate([o.ravel() for o in fp_out])
+    q_flat = np.concatenate([o.ravel() for o in q_out])
+    corr = float(np.corrcoef(fp_flat, q_flat)[0, 1])
     print(f"top-1 agreement with fp model: {agree}/{total} "
-          f"({100.0 * agree / total:.2f}%)", flush=True)
+          f"({100.0 * agree / total:.2f}%)  logit corr {corr:.4f}",
+          flush=True)
 
 
 if __name__ == "__main__":
