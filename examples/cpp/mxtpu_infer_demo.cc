@@ -11,6 +11,12 @@
 //
 // No Python anywhere. Build: make -C examples/cpp mxtpu_infer_demo
 // Run:  mxtpu_infer_demo <export-prefix> <input.params> <output.params>
+//
+// NOTE: this file deliberately spells out every raw PJRT/manifest call
+// — it is the "what the C ABI + PJRT C API actually look like"
+// reference. Application code should use the header-only frontend
+// instead (include/mxtpu_cpp.hpp, consumed by mxtpu_cpp_demo.cc),
+// which wraps the same sequence with RAII and error handling.
 //       (input.params holds one entry per manifest `input data j`,
 //        named "0", "1", ...; outputs land as "0", "1", ...)
 

@@ -274,12 +274,11 @@ def test_cpp_checkpoint_roundtrip_end_to_end(tmp_path):
     assert sorted(names) == sorted(before)
 
 
-def test_cpp_pjrt_inference_end_to_end(tmp_path):
-    """Round 5 (VERDICT item 4 stretch): a pure C++ program compiles the
-    exported StableHLO through the PJRT C API and executes inference ON
-    THE TPU — checkpoint in via the C ABI, logits out as .params, bit-
-    checked against the Python forward. Needs the axon plugin, so this
-    runs in the TPU tier and skips on the CPU mesh."""
+def _run_pjrt_demo(demo_name, tmp_path, in_units, hidden, classes,
+                   batch):
+    """Shared protocol for the TPU-tier PJRT C/C++ inference demos:
+    build-if-missing, export a small net, run the binary, and verify
+    the .params output against the Python forward."""
     import subprocess
 
     import pytest
@@ -291,23 +290,24 @@ def test_cpp_pjrt_inference_end_to_end(tmp_path):
 
     if os.environ.get("MXTPU_TEST_PLATFORM") != "tpu":
         pytest.skip("PJRT-from-C needs the real TPU (axon plugin)")
-    demo = os.path.join(REPO, "examples", "cpp", "mxtpu_infer_demo")
+    demo = os.path.join(REPO, "examples", "cpp", demo_name)
     if not os.path.exists(demo):
         r = subprocess.run(["make", "-C",
                             os.path.join(REPO, "examples", "cpp"),
-                            "mxtpu_infer_demo"],
+                            demo_name],
                            capture_output=True, text=True, timeout=240)
         if r.returncode != 0:
             pytest.skip(f"toolchain/PJRT header unavailable: "
                         f"{r.stderr[-200:]}")
 
     net = nn.HybridSequential()
-    net.add(nn.Dense(16, in_units=8, activation="relu"), nn.Dense(5))
+    net.add(nn.Dense(hidden, in_units=in_units, activation="relu"),
+            nn.Dense(classes))
     net.initialize(init="xavier")
-    net(mx.nd.zeros((1, 8)))
+    net(mx.nd.zeros((1, in_units)))
     prefix = str(tmp_path / "cnet")
-    monnx.export_for_pjrt_c(net, mx.nd.zeros((4, 8)), prefix)
-    x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    monnx.export_for_pjrt_c(net, mx.nd.zeros((batch, in_units)), prefix)
+    x = np.random.RandomState(0).rand(batch, in_units).astype(np.float32)
     nd.save(str(tmp_path / "in.params"), {"0": nd.array(x)})
     golden = net(nd.array(x)).asnumpy()
 
@@ -323,6 +323,22 @@ def test_cpp_pjrt_inference_end_to_end(tmp_path):
     assert "executed on TPU" in p.stdout
     out = nd.load(str(tmp_path / "out.params"))["0"].asnumpy()
     np.testing.assert_allclose(out, golden, rtol=2e-5, atol=2e-5)
+
+
+def test_cpp_pjrt_inference_end_to_end(tmp_path):
+    """Round 5 (VERDICT item 4 stretch): a pure C++ program compiles the
+    exported StableHLO through the PJRT C API and executes inference ON
+    THE TPU — checkpoint in via the C ABI, logits out as .params, bit-
+    checked against the Python forward. Needs the axon plugin, so this
+    runs in the TPU tier and skips on the CPU mesh."""
+    _run_pjrt_demo("mxtpu_infer_demo", tmp_path, 8, 16, 5, 4)
+
+
+def test_cpp_frontend_predictor_end_to_end(tmp_path):
+    """Round 5: the header-only C++ frontend (include/mxtpu_cpp.hpp —
+    the cpp-package analog) runs Checkpoint + RecordIO + PJRT Predictor
+    end to end; logits match the Python forward. TPU tier only."""
+    _run_pjrt_demo("mxtpu_cpp_demo", tmp_path, 6, 12, 4, 3)
 
 
 def test_native_params_writer_matches_python_and_numpy(tmp_path):
