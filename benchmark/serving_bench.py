@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Serving latency/throughput bench (docs/SERVING.md; BENCH row
+`serving`): concurrent clients through ModelServer's dynamic batcher vs
+the same traffic served unbatched, one forward per request.
+
+Reports requests/sec and p50/p99 request latency for both paths plus
+the measured batch occupancy — the number dynamic batching exists to
+raise. Runs on whatever backend jax selects (CPU fallback included):
+
+    python benchmark/serving_bench.py [--requests 512] [--clients 16] \
+        [--in-dim 256] [--hidden 512] [--wait-ms 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_net(in_dim: int, hidden: int, out_dim: int):
+    import incubator_mxnet_tpu as mx
+
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(hidden, activation="relu",
+                                  in_units=in_dim))
+        net.add(mx.gluon.nn.Dense(out_dim, in_units=hidden))
+    net.initialize()
+    return net
+
+
+def pctl(vals, p):
+    return sorted(vals)[min(len(vals) - 1, int(p / 100.0 * len(vals)))]
+
+
+def run_unbatched(net, xs):
+    """One compiled forward per request, sequential — the Predictor-loop
+    baseline a client would run without a server."""
+    import incubator_mxnet_tpu as mx
+
+    net.hybridize()
+    x0 = mx.nd.array(xs[0][None])
+    net(x0).asnumpy()                      # compile outside the clock
+    lats = []
+    t0 = time.perf_counter()
+    for x in xs:
+        t1 = time.perf_counter()
+        net(mx.nd.array(x[None])).asnumpy()
+        lats.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    return wall, lats
+
+
+def run_served(net, xs, clients, wait_ms, buckets):
+    from incubator_mxnet_tpu import serving
+
+    srv = serving.ModelServer(net, buckets=buckets, max_wait_ms=wait_ms,
+                              max_queue=4 * buckets[-1], name="bench")
+    try:
+        srv.warmup(xs.shape[1:], xs.dtype)
+        lats = []
+        lock = threading.Lock()
+
+        def client(rows):
+            for x in rows:
+                t1 = time.perf_counter()
+                while True:
+                    try:
+                        fut = srv.submit(x)
+                        break
+                    except serving.QueueFullError as e:   # backpressure
+                        time.sleep(e.retry_after)
+                fut.result(timeout=60)
+                with lock:
+                    lats.append(time.perf_counter() - t1)
+
+        shards = [xs[i::clients] for i in range(clients)]
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in shards if len(s)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return wall, lats, srv.stats()
+    finally:
+        srv.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--in-dim", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--out-dim", type=int, default=64)
+    ap.add_argument("--wait-ms", type=float, default=2.0)
+    ap.add_argument("--buckets", type=str, default="1,2,4,8,16,32")
+    args = ap.parse_args()
+
+    import jax
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    net = build_net(args.in_dim, args.hidden, args.out_dim)
+    xs = np.random.RandomState(0).rand(
+        args.requests, args.in_dim).astype(np.float32)
+
+    uw, ul = run_unbatched(net, xs)
+    sw, sl, stats = run_served(net, xs, args.clients, args.wait_ms, buckets)
+
+    n = args.requests
+    print(f"serving bench — backend={jax.default_backend()} "
+          f"requests={n} clients={args.clients} "
+          f"net={args.in_dim}x{args.hidden}x{args.out_dim} "
+          f"buckets={buckets} wait={args.wait_ms}ms")
+    print(f"  unbatched : {n / uw:9.1f} req/s   "
+          f"p50 {pctl(ul, 50) * 1e3:7.2f} ms   "
+          f"p99 {pctl(ul, 99) * 1e3:7.2f} ms")
+    print(f"  batched   : {n / sw:9.1f} req/s   "
+          f"p50 {pctl(sl, 50) * 1e3:7.2f} ms   "
+          f"p99 {pctl(sl, 99) * 1e3:7.2f} ms   "
+          f"occupancy {stats['batch_occupancy']:.1f}   "
+          f"compiles {stats['executor_cache']['compiles']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,7 +57,7 @@ PJRT_Buffer_Type ToPjrtType(int tf) {
     case 4: return PJRT_Buffer_Type_S32;
     case 5: return PJRT_Buffer_Type_S8;
     case 6: return PJRT_Buffer_Type_S64;
-    case 7: return PJRT_Buffer_Type_BF16;
+    case 12: return PJRT_Buffer_Type_BF16;
     default: return PJRT_Buffer_Type_INVALID;
   }
 }
@@ -65,7 +65,7 @@ int TypeSize(int tf) {
   switch (tf) {
     case 0: case 4: return 4;
     case 1: case 6: return 8;
-    case 2: case 7: return 2;
+    case 2: case 12: return 2;
     default: return 1;
   }
 }

@@ -55,10 +55,11 @@ int mxio_recwriter_close(void* h);
 namespace mxtpu {
 namespace cpp {
 
-// reference mshadow TypeFlag codes (the C ABI's dtype convention)
+// reference mshadow TypeFlag codes (the C ABI's dtype convention);
+// kBfloat16 is 12, matching the reference enum (7 there is kBool)
 enum class DType : int {
   kFloat32 = 0, kFloat64 = 1, kFloat16 = 2, kUint8 = 3,
-  kInt32 = 4, kInt8 = 5, kInt64 = 6, kBfloat16 = 7,
+  kInt32 = 4, kInt8 = 5, kInt64 = 6, kBfloat16 = 12,
 };
 
 inline int DTypeSize(DType t) {
@@ -255,7 +256,20 @@ class Predictor {
     params_ = Checkpoint::Load(params_path);
     ParseManifest(ReadFile(prefix + ".manifest"));
     InitClient();
-    Compile();
+    try {
+      Compile();
+      // weights go device-resident once here; Forward only moves the
+      // data inputs (the MXPredCreate residency contract — repeated
+      // Forward calls must not pay full-checkpoint H2D latency)
+      UploadParams();
+    } catch (...) {
+      // a throwing constructor never runs the destructor — release the
+      // client/executable/buffers here or every failed construction
+      // leaks device memory
+      Release();
+      throw;
+    }
+    params_.clear();  // device copies are authoritative now
   }
 
   struct IOSpec {
@@ -267,22 +281,7 @@ class Predictor {
   const std::vector<IOSpec>& inputs() const { return inputs_; }
   const std::vector<IOSpec>& outputs() const { return outputs_; }
 
-  ~Predictor() {
-    if (exec_) {
-      PJRT_LoadedExecutable_Destroy_Args ld;
-      std::memset(&ld, 0, sizeof ld);
-      ld.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
-      ld.executable = exec_;
-      api_->PJRT_LoadedExecutable_Destroy(&ld);
-    }
-    if (client_) {
-      PJRT_Client_Destroy_Args cd;
-      std::memset(&cd, 0, sizeof cd);
-      cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
-      cd.client = client_;
-      api_->PJRT_Client_Destroy(&cd);
-    }
-  }
+  ~Predictor() { Release(); }
   Predictor(const Predictor&) = delete;
   Predictor& operator=(const Predictor&) = delete;
 
@@ -304,47 +303,113 @@ class Predictor {
   }
 
  private:
+  // Free every PJRT resource this object owns (destructor body; also
+  // the constructor's failure path, where the destructor won't run).
+  void Release() {
+    for (auto*& b : param_bufs_) {
+      if (b) DestroyBuffer(b);
+      b = nullptr;
+    }
+    if (exec_) {
+      PJRT_LoadedExecutable_Destroy_Args ld;
+      std::memset(&ld, 0, sizeof ld);
+      ld.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      ld.executable = exec_;
+      api_->PJRT_LoadedExecutable_Destroy(&ld);
+      exec_ = nullptr;
+    }
+    if (client_) {
+      PJRT_Client_Destroy_Args cd;
+      std::memset(&cd, 0, sizeof cd);
+      cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      cd.client = client_;
+      api_->PJRT_Client_Destroy(&cd);
+      client_ = nullptr;
+    }
+  }
+
+  // One H2D transfer. Returns the device buffer; *done receives the
+  // done_with_host_buffer event so callers can batch the awaits.
+  PJRT_Buffer* TransferToDevice(const Tensor& host, const IOSpec& in,
+                                PJRT_Event** done) {
+    int64_t want = DTypeSize(in.dtype);
+    for (int64_t d : in.dims) want *= d;
+    if (host.NumBytes() != want)
+      throw std::runtime_error(in.key + ": byte-size mismatch");
+    PJRT_Client_BufferFromHostBuffer_Args bh;
+    std::memset(&bh, 0, sizeof bh);
+    bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bh.client = client_;
+    bh.data = host.data.data();
+    bh.type = ToPjrtType(in.dtype);
+    bh.dims = in.dims.data();
+    bh.num_dims = in.dims.size();
+    bh.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    bh.device = device_;
+    Check(api_->PJRT_Client_BufferFromHostBuffer(&bh), "h2d");
+    *done = bh.done_with_host_buffer;
+    return bh.buffer;
+  }
+
+  // Upload every param input once; all transfers are issued before any
+  // await so the copies overlap instead of serializing per-buffer.
+  void UploadParams() {
+    param_bufs_.assign(inputs_.size(), nullptr);
+    std::vector<PJRT_Event*> dones;
+    try {
+      for (size_t i = 0; i < inputs_.size(); ++i) {
+        if (!inputs_[i].is_param) continue;
+        auto it = params_.find(inputs_[i].key);
+        if (it == params_.end())
+          throw std::runtime_error("missing param " + inputs_[i].key);
+        PJRT_Event* done = nullptr;
+        param_bufs_[i] = TransferToDevice(it->second, inputs_[i], &done);
+        dones.push_back(done);
+      }
+      AwaitAll(&dones, "param h2d done");
+    } catch (...) {
+      DestroyEvents(&dones);
+      for (auto*& b : param_bufs_)
+        if (b) { DestroyBuffer(b); b = nullptr; }
+      throw;
+    }
+  }
+
   std::vector<Tensor> ForwardImpl(const std::vector<Tensor>& data_inputs,
                                   std::vector<PJRT_Buffer*>* bufs_out,
                                   std::vector<PJRT_Buffer*>* outs_guard) {
+    // bufs tracks only per-call (data) buffers — params stay resident
     std::vector<PJRT_Buffer*>& bufs = *bufs_out;
-    for (const auto& in : inputs_) {
-      const Tensor* host;
-      if (in.is_param) {
-        auto it = params_.find(in.key);
-        if (it == params_.end())
-          throw std::runtime_error("missing param " + in.key);
-        host = &it->second;
-      } else {
+    std::vector<PJRT_Buffer*> args(inputs_.size(), nullptr);
+    std::vector<PJRT_Event*> dones;
+    try {
+      for (size_t i = 0; i < inputs_.size(); ++i) {
+        const IOSpec& in = inputs_[i];
+        if (in.is_param) {
+          args[i] = param_bufs_[i];
+          continue;
+        }
         size_t j = std::stoul(in.key);
         if (j >= data_inputs.size())
           throw std::runtime_error("missing data input " + in.key);
-        host = &data_inputs[j];
+        PJRT_Event* done = nullptr;
+        args[i] = TransferToDevice(data_inputs[j], in, &done);
+        bufs.push_back(args[i]);
+        dones.push_back(done);
       }
-      int64_t want = DTypeSize(in.dtype);
-      for (int64_t d : in.dims) want *= d;
-      if (host->NumBytes() != want)
-        throw std::runtime_error(in.key + ": byte-size mismatch");
-      PJRT_Client_BufferFromHostBuffer_Args bh;
-      std::memset(&bh, 0, sizeof bh);
-      bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-      bh.client = client_;
-      bh.data = host->data.data();
-      bh.type = ToPjrtType(in.dtype);
-      bh.dims = in.dims.data();
-      bh.num_dims = in.dims.size();
-      bh.host_buffer_semantics =
-          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-      bh.device = device_;
-      Check(api_->PJRT_Client_BufferFromHostBuffer(&bh), "h2d");
-      Await(bh.done_with_host_buffer, "h2d done");
-      bufs.push_back(bh.buffer);
+      AwaitAll(&dones, "h2d done");
+    } catch (...) {
+      // buffers are released by Forward's guard; pending events are
+      // this scope's to free
+      DestroyEvents(&dones);
+      throw;
     }
 
     PJRT_ExecuteOptions eo;
     std::memset(&eo, 0, sizeof eo);
     eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-    PJRT_Buffer** arg_list = bufs.data();
+    PJRT_Buffer** arg_list = args.data();
     std::vector<PJRT_Buffer*>& out_bufs = *outs_guard;
     out_bufs.assign(outputs_.size(), nullptr);
     PJRT_Buffer** out_list = out_bufs.data();
@@ -354,7 +419,7 @@ class Predictor {
     ex.executable = exec_;
     ex.options = &eo;
     ex.num_devices = 1;
-    ex.num_args = bufs.size();
+    ex.num_args = args.size();
     ex.argument_lists = &arg_list;
     ex.output_lists = &out_list;
     Check(api_->PJRT_LoadedExecutable_Execute(&ex), "execute");
@@ -425,12 +490,35 @@ class Predictor {
     aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
     aw.event = ev;
     PJRT_Error* err = api_->PJRT_Event_Await(&aw);
+    DestroyEvent(ev);
+    Check(err, what);
+  }
+
+  void DestroyEvent(PJRT_Event* ev) {
     PJRT_Event_Destroy_Args ed;
     std::memset(&ed, 0, sizeof ed);
     ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
     ed.event = ev;
     api_->PJRT_Event_Destroy(&ed);
-    Check(err, what);
+  }
+
+  // Await a batch of transfer events; on ANY failure (including an
+  // exception thrown before this is reached, via the caller's catch)
+  // un-awaited events must still be destroyed or each failing call
+  // leaks one — entries are nulled as Await consumes them.
+  void AwaitAll(std::vector<PJRT_Event*>* dones, const char* what) {
+    for (auto*& ev : *dones) {
+      PJRT_Event* e = ev;
+      ev = nullptr;                  // Await destroys it, success or not
+      Await(e, what);
+    }
+  }
+
+  void DestroyEvents(std::vector<PJRT_Event*>* dones) {
+    for (auto*& ev : *dones) {
+      if (ev) DestroyEvent(ev);
+      ev = nullptr;
+    }
   }
 
   void DestroyBuffer(PJRT_Buffer* b) {
@@ -566,6 +654,9 @@ class Predictor {
 
   std::string prefix_, topology_, session_, code_, copts_;
   std::map<std::string, Tensor> params_;
+  // device-resident weights, index-aligned with inputs_ (null for the
+  // data slots); uploaded once at construction
+  std::vector<PJRT_Buffer*> param_bufs_;
   std::vector<IOSpec> inputs_, outputs_;
   const PJRT_Api* api_ = nullptr;
   PJRT_Client* client_ = nullptr;

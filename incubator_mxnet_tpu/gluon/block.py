@@ -236,6 +236,19 @@ class Block:
                         allow_missing: bool = False,
                         ignore_extra: bool = False, cast_dtype: bool = False):
         loaded = _ndimpl.load(filename, ctx=ctx)
+        self._load_parameters_dict(loaded, filename, ctx=ctx,
+                                   allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra,
+                                   cast_dtype=cast_dtype)
+
+    def _load_parameters_dict(self, loaded, source: str, ctx=None,
+                              allow_missing: bool = False,
+                              ignore_extra: bool = False,
+                              cast_dtype: bool = False):
+        """``load_parameters`` over an in-memory ``{name: NDArray}`` dict —
+        the entry point for alternative readers (serving's native C-ABI
+        checkpoint path loads through here)."""
+        filename = source
         params = self._collect_params_with_prefix()
         if loaded and params and all("." not in k for k in loaded) \
                 and any("." in k for k in params):
@@ -305,6 +318,13 @@ def _indent(s, n):
 def _camel_to_snake(name: str) -> str:
     return re.sub("([a-z0-9])([A-Z])", r"\1_\2",
                   re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)).lower()
+
+
+def _export_input_name(i: int, n: int) -> str:
+    """Graph input naming shared by ``export()`` and
+    ``export_for_serving()`` — the serving spec must name exactly the
+    inputs the symbol json declares."""
+    return "data" if n == 1 else f"data{i}"
 
 
 # ---------------------------------------------------------------------------
@@ -599,7 +619,7 @@ class HybridBlock(Block):
         by_name = self._collect_params_with_prefix()
         id2entry = {}
         for i, x in enumerate(ins):
-            name = "data" if len(ins) == 1 else f"data{i}"
+            name = _export_input_name(i, len(ins))
             id2entry[id(x)] = (_Node(None, name, {}, []), 0)
         for pname, p in by_name.items():
             if p._data is not None:
@@ -673,6 +693,36 @@ class HybridBlock(Block):
         sym.save(f"{path}-symbol.json")
         self.save_parameters(f"{path}-{epoch:04d}.params")
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def export_for_serving(self, path: str, epoch: int = 0,
+                           buckets=(1, 2, 4, 8)):
+        """Serialize for the serving subsystem: ``export()`` artifacts
+        plus ``path-serving.json`` recording the request signature
+        (input names, per-example feature shapes with the batch axis
+        stripped, dtypes) and suggested batch buckets.
+        ``serving.ModelServer.from_exported`` consumes the trio.
+        """
+        import json
+        import os
+
+        import numpy as _np
+
+        sym_file, params_file = self.export(path, epoch)
+        spec = {
+            "version": 1,
+            "symbol": os.path.basename(sym_file),
+            "params": os.path.basename(params_file),
+            "buckets": list(int(b) for b in buckets),
+            "inputs": [
+                {"name": _export_input_name(i, len(self._last_input_spec)),
+                 "features": [int(d) for d in shape[1:]],
+                 "dtype": _np.dtype(dtype).name}
+                for i, (shape, dtype) in enumerate(self._last_input_spec)],
+        }
+        spec_file = f"{path}-serving.json"
+        with open(spec_file, "w") as f:
+            json.dump(spec, f, indent=1)
+        return spec_file
 
 
 class SymbolBlock(HybridBlock):

@@ -106,9 +106,10 @@ def lib() -> Optional[ctypes.CDLL]:
     return _LIB
 
 
-# reference mshadow TypeFlag codes <-> numpy (native checkpoint ABI)
+# reference mshadow TypeFlag codes <-> numpy (native checkpoint ABI).
+# bfloat16 is 12 (kBfloat16) — 7 is kBool in the reference enum.
 _DTYPE_CODES = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
-                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 7}
+                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 12}
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
@@ -133,7 +134,10 @@ def native_params_load(path: str):
             shape = (ctypes.c_int64 * 32)()
             ndim = l.mxio_params_info(h, i, ctypes.byref(dt), shape, 32,
                                       ctypes.byref(nb))
-            if ndim < 0 or dt.value not in _CODE_DTYPES:
+            # ndim > 32 mirrors the C++ Checkpoint::Load guard: the shape
+            # buffer only holds 32 dims, so a deeper entry would reshape
+            # against a truncated shape
+            if ndim < 0 or ndim > 32 or dt.value not in _CODE_DTYPES:
                 raise IOError(
                     f"{name}: unsupported entry (ndim={ndim}, "
                     f"descr={l.mxio_params_descr(h, i).decode()!r})")
@@ -142,7 +146,7 @@ def native_params_load(path: str):
             buf = (ctypes.c_uint8 * max(nb.value, 1))()
             if l.mxio_params_read(h, i, buf, nb.value) != nb.value:
                 raise IOError(f"{name}: short read")
-            if dt.value == 7:
+            if dt.value == 12:
                 import ml_dtypes
 
                 npdt = ml_dtypes.bfloat16

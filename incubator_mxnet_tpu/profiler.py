@@ -212,3 +212,11 @@ class Marker:
 def scope(name: str):
     """Convenience profiling scope also visible in the XLA trace."""
     return Event(name)
+
+
+def counter(name: str, value=None) -> Counter:
+    """Standalone named counter (no Domain). The serving subsystem
+    publishes queue depth and batch occupancy through this so they show
+    up as counter tracks in the chrome trace next to its execution
+    scopes."""
+    return Counter(None, name, value)

@@ -44,6 +44,7 @@ def _detect():
     feats["OPENCV"] = _has_module("cv2")
     feats["RECORDIO_NATIVE"] = _native_recordio_available()
     feats["AMP"] = True
+    feats["SERVING"] = True           # mxtpu.serving (docs/SERVING.md)
     return feats
 
 

@@ -1,0 +1,28 @@
+"""``mx.serving`` — dynamic-batching inference (docs/SERVING.md).
+
+The serving layer the reference stack exposed through ``Module.predict``,
+the C predict API, and MXNet Model Server, rebuilt TPU-native:
+
+* ``BucketedExecutorCache`` — requests are padded to a small set of
+  batch-size buckets; one ahead-of-time-compiled XLA executable per
+  (model, bucket, signature), parameters device-resident.
+* ``DynamicBatcher`` — concurrent single requests coalesce into batches
+  under a ``max_batch_size`` / ``max_wait_ms`` flush policy, with
+  bounded-queue backpressure (``QueueFullError.retry_after``).
+* ``ModelServer`` — load (gluon Block, native checkpoint, or
+  ``export_for_serving`` artifacts), warm up, serve, drain, shut down.
+* ``ServingMetrics`` — latency percentiles, queue depth, batch
+  occupancy, cache hit/miss — also published into profiler traces.
+"""
+
+from .batcher import DynamicBatcher, QueueFullError, ServerClosedError
+from .executor_cache import (DEFAULT_BUCKETS, BucketedExecutorCache,
+                             block_apply_fn)
+from .metrics import ServingMetrics
+from .server import ModelServer
+
+__all__ = [
+    "BucketedExecutorCache", "DEFAULT_BUCKETS", "DynamicBatcher",
+    "ModelServer", "QueueFullError", "ServerClosedError", "ServingMetrics",
+    "block_apply_fn",
+]

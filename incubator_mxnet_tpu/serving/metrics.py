@@ -1,0 +1,118 @@
+"""Per-model serving metrics.
+
+One ``ServingMetrics`` instance is shared by a model's executor cache,
+batcher, and server so every layer reports into the same ledger:
+request latency percentiles (sliding window), queue depth, batch
+occupancy (requests per executed batch — the number dynamic batching
+exists to raise), and executor-cache hit/miss/compile counters.
+
+The live gauges are also published through ``profiler.counter`` so a
+profiling run (``profiler.set_state('run')``) shows queue depth and
+batch size as counter tracks in the chrome trace, next to the
+``serving::<model>::*`` execution scopes the server emits.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from .. import profiler
+
+
+def _percentile(sorted_vals, p: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(p / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[k]
+
+
+class ServingMetrics:
+    """Thread-safe counters + sliding-window latency reservoir."""
+
+    def __init__(self, model: str = "model", window: int = 2048):
+        self.model = model
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=window)     # seconds per request
+        self._batch_sizes = deque(maxlen=window)   # requests per batch
+        self.requests = 0
+        self.rejected = 0
+        self.batches = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.queue_depth = 0
+        self._c_depth = profiler.counter(f"serving/{model}/queue_depth")
+        self._c_batch = profiler.counter(f"serving/{model}/batch_size")
+
+    # -- batcher-side observations -------------------------------------------
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+        self._c_depth.set_value(depth)
+
+    def observe_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def observe_batch(self, batch_size: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self._batch_sizes.append(batch_size)
+        self._c_batch.set_value(batch_size)
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self._latencies.append(seconds)
+
+    # -- executor-cache-side observations ------------------------------------
+    def cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
+
+    def cache_miss(self) -> None:
+        with self._lock:
+            self.cache_misses += 1
+
+    def observe_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += seconds
+
+    # -- reads ----------------------------------------------------------------
+    def latency_ms(self, p: float) -> float:
+        """Latency percentile in milliseconds over the sliding window."""
+        with self._lock:
+            vals = sorted(self._latencies)
+        return _percentile(vals, p) * 1e3
+
+    def mean_batch_occupancy(self) -> float:
+        """Mean requests per executed batch (> 1 means batching works)."""
+        with self._lock:
+            sizes = list(self._batch_sizes)
+        return sum(sizes) / len(sizes) if sizes else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        occ = self.mean_batch_occupancy()
+        with self._lock:
+            vals = sorted(self._latencies)   # one sort for all percentiles
+        return {
+            "model": self.model,
+            "requests": self.requests,
+            "rejected": self.rejected,
+            "batches": self.batches,
+            "queue_depth": self.queue_depth,
+            "batch_occupancy": occ,
+            "latency_ms": {f"p{p}": _percentile(vals, p) * 1e3
+                           for p in (50, 90, 99)},
+            "executor_cache": {"hits": self.cache_hits,
+                               "misses": self.cache_misses,
+                               "compiles": self.compiles,
+                               "compile_seconds": self.compile_seconds},
+        }

@@ -1,0 +1,192 @@
+"""ModelServer — the serving front door over batcher + executor cache.
+
+Owns one model end to end: load (from a live gluon ``Block``, a native
+``.params`` checkpoint through the C ABI, or ``export_for_serving``
+artifacts), warm up the bucketed executables, dispatch traffic through
+the dynamic batcher on a worker thread, and wind down cleanly (graceful
+drain vs immediate shutdown). The MXNet Model Server / ``Module
+.predict`` capability, rebuilt TPU-native on AOT-compiled XLA
+executables with device-resident weights.
+
+Usage::
+
+    import incubator_mxnet_tpu as mx
+
+    net = mx.gluon.nn.Dense(10, in_units=784)
+    net.initialize()
+    with mx.serving.ModelServer(net, max_wait_ms=2.0) as srv:
+        srv.warmup((784,), "float32")
+        fut = srv.submit(example)          # one example, no batch axis
+        probs = fut.result()
+        print(srv.stats()["latency_ms"]["p99"])
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import Future
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import profiler
+from .batcher import DynamicBatcher, QueueFullError, ServerClosedError
+from .executor_cache import DEFAULT_BUCKETS, BucketedExecutorCache
+from .metrics import ServingMetrics
+
+__all__ = ["ModelServer", "QueueFullError", "ServerClosedError"]
+
+
+class ModelServer:
+    """Serve one model with dynamic batching and bucketed AOT executors.
+
+    ``model`` is a gluon ``Block`` (parameters initialized) or an
+    already-built ``BucketedExecutorCache``. ``max_batch_size`` defaults
+    to the largest bucket; it may not exceed it (a flushed batch must
+    fit the biggest executable).
+    """
+
+    def __init__(self, model, buckets: Optional[Sequence[int]] = None,
+                 max_batch_size: Optional[int] = None,
+                 max_wait_ms: float = 5.0, max_queue: int = 64,
+                 name: Optional[str] = None,
+                 donate: Optional[bool] = None):
+        if isinstance(model, BucketedExecutorCache):
+            if buckets is not None or donate is not None:
+                raise ValueError(
+                    "buckets/donate are fixed by the prebuilt "
+                    "BucketedExecutorCache; configure them there")
+            self._cache = model
+            name = name or model.name
+        else:
+            name = name or (getattr(model, "name", "") or "model")
+            self._cache = BucketedExecutorCache.from_block(
+                model,
+                buckets=DEFAULT_BUCKETS if buckets is None else buckets,
+                donate=donate, name=name, metrics=ServingMetrics(name))
+        self.name = name
+        self.metrics: ServingMetrics = self._cache.metrics
+        if max_batch_size is None:
+            max_batch_size = self._cache.max_batch_size
+        if max_batch_size > self._cache.max_batch_size:
+            raise ValueError(
+                f"max_batch_size={max_batch_size} exceeds the largest "
+                f"bucket {self._cache.max_batch_size}")
+        self._batcher = DynamicBatcher(
+            self._run_batch, max_batch_size=max_batch_size,
+            max_wait_ms=max_wait_ms, max_queue=max_queue,
+            metrics=self.metrics, name=name)
+
+    # -- construction from artifacts -----------------------------------------
+    @classmethod
+    def from_checkpoint(cls, block, params_path: str, ctx=None,
+                        use_native: Optional[bool] = None,
+                        **kwargs) -> "ModelServer":
+        """Load ``params_path`` into ``block`` and serve it. Reads through
+        the native C ABI (``mxio_params_*``) when the library is
+        available — the same reader non-Python consumers use — else
+        falls back to ``nd.load``. ``use_native=True`` makes a missing
+        native library an error instead of a silent fallback."""
+        from .. import native
+        from ..ndarray import ndarray as _ndimpl
+
+        if use_native is None:
+            use_native = native.lib() is not None
+        if use_native:
+            arrays = native.native_params_load(params_path)
+            loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
+                      for k, v in arrays.items()}
+            block._load_parameters_dict(loaded, params_path, ctx=ctx)
+        else:
+            block.load_parameters(params_path, ctx=ctx)
+        return cls(block, **kwargs)
+
+    @classmethod
+    def from_exported(cls, path: str, ctx=None, **kwargs) -> "ModelServer":
+        """Serve ``HybridBlock.export_for_serving`` artifacts: rebuilds
+        the graph as a ``SymbolBlock``, loads the checkpoint, applies the
+        recorded buckets, and warms up every bucket for the recorded
+        input signature."""
+        from ..gluon.block import SymbolBlock
+
+        with open(f"{path}-serving.json") as f:
+            spec = json.load(f)
+        if spec.get("version") != 1:
+            raise ValueError(f"unsupported serving spec {path}-serving.json")
+        if len(spec["inputs"]) != 1:
+            raise NotImplementedError(
+                "serving currently batches single-input models")
+        base = os.path.dirname(os.path.abspath(path))
+        block = SymbolBlock.imports(
+            os.path.join(base, spec["symbol"]),
+            [io["name"] for io in spec["inputs"]],
+            os.path.join(base, spec["params"]), ctx=ctx)
+        kwargs.setdefault("buckets", spec["buckets"])
+        kwargs.setdefault("name", os.path.basename(path))
+        srv = cls(block, **kwargs)
+        io0 = spec["inputs"][0]
+        srv.warmup(tuple(io0["features"]), io0["dtype"])
+        return srv
+
+    # -- dispatch -------------------------------------------------------------
+    def _run_batch(self, batch: np.ndarray):
+        with profiler.scope(f"serving::{self.name}::batch"):
+            out = self._cache(batch)
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o) for o in out)
+        return np.asarray(out)
+
+    def submit(self, example) -> Future:
+        """Enqueue one example (feature shape, no batch axis); resolves to
+        the model output row (or tuple of rows for multi-output nets).
+        Raises ``QueueFullError`` (backpressure) / ``ServerClosedError``."""
+        return self._batcher.submit(example)
+
+    def predict(self, example, timeout: Optional[float] = 60.0):
+        """Synchronous ``submit`` — one request through the batcher."""
+        return self.submit(example).result(timeout=timeout)
+
+    # -- lifecycle ------------------------------------------------------------
+    def warmup(self, feature_shape: Tuple[int, ...], dtype="float32",
+               buckets: Optional[Sequence[int]] = None) -> None:
+        """Compile every bucket for the given request signature before
+        traffic arrives (cold-start compiles otherwise land on the first
+        unlucky requests), and pin the accepted signature."""
+        self._cache.warmup(tuple(feature_shape), dtype, buckets)
+        self._batcher.expect_features(tuple(feature_shape), dtype)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful: refuse new requests, answer everything queued."""
+        return self._batcher.drain(timeout)
+
+    def close(self) -> None:
+        """Immediate: fail queued requests, stop the worker."""
+        self._batcher.close()
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc and exc[0] is None:
+            self.drain(timeout=30.0)
+        self.close()
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self._batcher.queue_depth
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._cache.buckets
+
+    def compiled_signatures(self):
+        """(bucket, feature_shape, dtype) keys with a live executable."""
+        return self._cache.compiled_signatures()
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["buckets"] = list(self.buckets)
+        snap["compiled"] = [list(k) for k in self.compiled_signatures()]
+        return snap

@@ -65,8 +65,9 @@ void WrU32(std::string* s, uint32_t v) {
 }
 
 // dtype codes: reference mshadow/base.h TypeFlag values (kFloat32=0,
-// kFloat64=1, kFloat16=2, kUint8=3, kInt32=4, kInt8=5, kInt64=6) plus
-// 7 = bfloat16 (ml_dtypes '<V2'/bfloat16 descr). -1 = unknown (raw
+// kFloat64=1, kFloat16=2, kUint8=3, kInt32=4, kInt8=5, kInt64=6,
+// kBfloat16=12 — NOT 7, which is kBool in the reference enum; the
+// ml_dtypes '<V2'/bfloat16 descr maps to 12). -1 = unknown (raw
 // bytes still readable via mxio_params_read + mxio_params_descr).
 struct DescrMap {
   const char* descr;
@@ -75,14 +76,14 @@ struct DescrMap {
 };
 constexpr DescrMap kDescrs[] = {
     {"<f4", 0, 4}, {"<f8", 1, 8}, {"<f2", 2, 2}, {"|u1", 3, 1},
-    {"<i4", 4, 4}, {"|i1", 5, 1}, {"<i8", 6, 8}, {"bfloat16", 7, 2},
-    {"<V2", 7, 2},
+    {"<i4", 4, 4}, {"|i1", 5, 1}, {"<i8", 6, 8}, {"bfloat16", 12, 2},
+    {"<V2", 12, 2},
 };
 
 int DescrToCode(const std::string& d) {
   for (const auto& m : kDescrs)
     if (d == m.descr) return m.code;
-  if (d.find("bfloat16") != std::string::npos) return 7;
+  if (d.find("bfloat16") != std::string::npos) return 12;
   return -1;
 }
 
@@ -217,6 +218,10 @@ void* mxio_params_open(const char* path) {
     uint16_t xlen = RdU16(&cd[p + 30]);
     uint16_t clen = RdU16(&cd[p + 32]);
     uint32_t lho = RdU32(&cd[p + 42]);
+    // variable-length fields must also lie inside the directory buffer,
+    // or a corrupt nlen reads up to ~64KB past the heap allocation
+    if (p + 46 + static_cast<size_t>(nlen) + xlen + clen > cd.size())
+      break;
     std::string name(reinterpret_cast<const char*>(&cd[p + 46]), nlen);
     p += 46 + nlen + xlen + clen;
     if (method != 0 || csize != usize) continue;   // compressed: skip
@@ -243,11 +248,19 @@ void* mxio_params_open(const char* path) {
       if (std::fread(nh + 10, 1, 2, f) != 2) continue;
       hlen = RdU32(&nh[8]); hdr_start = 12;
     }
+    // validate BEFORE the hlen-sized allocation: a corrupt v2 header
+    // length (u32) could demand ~4 GB and throw bad_alloc across the C
+    // boundary; and a usize smaller than the npy header would wrap
+    // data_len to a multi-exabyte size_t
+    if (usize < hdr_start + hlen) continue;
+    if (data_off + hdr_start + hlen > static_cast<size_t>(fsize)) continue;
     std::string hdr(hlen, '\0');
     if (std::fread(&hdr[0], 1, hlen, f) != hlen) continue;
     if (!ParseNpyDict(hdr, &e)) continue;
     e.data_off = data_off + hdr_start + hlen;
     e.data_len = usize - (hdr_start + hlen);
+    // the member's data bytes must lie inside the file too
+    if (e.data_off + e.data_len > static_cast<size_t>(fsize)) continue;
     pf->entries.push_back(std::move(e));
   }
   return pf;
@@ -351,7 +364,7 @@ void* mxio_params_writer_open(const char* path) {
 }
 
 // Append one array. dtype: reference TypeFlag code (0=f32, 1=f64, 2=f16,
-// 3=u8, 4=i32, 5=i8, 6=i64, 7=bf16). data is C-order. Returns 0 ok.
+// 3=u8, 4=i32, 5=i8, 6=i64, 12=bf16). data is C-order. Returns 0 ok.
 int mxio_params_writer_add(void* h, const char* name, int dtype, int ndim,
                            const int64_t* shape, const void* data) {
   auto* w = static_cast<ParamsWriter*>(h);

@@ -1,0 +1,540 @@
+"""Serving subsystem tests (docs/SERVING.md): bucketed executor cache,
+dynamic batcher flush policy + backpressure, ModelServer lifecycle, and
+the corrupt-checkpoint regressions for the hardened native reader."""
+
+import json
+import os
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import serving
+from incubator_mxnet_tpu.serving import (BucketedExecutorCache,
+                                         DynamicBatcher, ModelServer,
+                                         QueueFullError, ServerClosedError,
+                                         ServingMetrics)
+
+
+def _dense(out=3, inp=4, seed=0):
+    net = mx.gluon.nn.Dense(out, in_units=inp)
+    net.initialize(mx.initializer.Xavier(rnd_type="gaussian"))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# executor cache
+# ---------------------------------------------------------------------------
+def test_bucket_selection():
+    cache = BucketedExecutorCache.from_block(_dense(), buckets=(4, 1, 8, 2))
+    assert cache.buckets == (1, 2, 4, 8)       # sorted, deduped
+    assert cache.bucket_for(1) == 1
+    assert cache.bucket_for(2) == 2
+    assert cache.bucket_for(3) == 4
+    assert cache.bucket_for(8) == 8
+    with pytest.raises(ValueError):
+        cache.bucket_for(9)                     # above the largest bucket
+    with pytest.raises(ValueError):
+        cache.bucket_for(0)
+    with pytest.raises(ValueError):
+        BucketedExecutorCache.from_block(_dense(), buckets=())
+
+
+def test_cache_pad_depad_and_one_compile_per_bucket():
+    net = _dense()
+    cache = BucketedExecutorCache.from_block(net, buckets=(2, 4))
+    rs = np.random.RandomState(0)
+    for n in (1, 2, 3, 4, 3, 2, 1):             # ragged repeat traffic
+        x = rs.rand(n, 4).astype(np.float32)
+        out = np.asarray(cache(x))
+        assert out.shape == (n, 3)              # de-padded to true size
+        ref = net(mx.nd.array(x)).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # 7 calls over 2 buckets: exactly one compile each, the rest hits
+    m = cache.metrics
+    assert m.compiles == 2
+    assert m.cache_misses == 2
+    assert m.cache_hits == 5
+    assert cache.compiled_signatures() == [(2, (4,), "float32"),
+                                           (4, (4,), "float32")]
+
+
+def test_cache_params_stay_resident():
+    """The executable closes over device-resident params: mutating the
+    Block afterwards must NOT change served results (the cache owns the
+    weights, like the C++ Predictor after the residency fix)."""
+    net = _dense()
+    cache = BucketedExecutorCache.from_block(net, buckets=(2,))
+    x = np.ones((2, 4), np.float32)
+    before = np.asarray(cache(x)).copy()
+    net.weight.set_data(mx.nd.zeros(net.weight.shape))
+    np.testing.assert_allclose(np.asarray(cache(x)), before)
+
+
+def test_cache_multi_output_block():
+    class TwoHead(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = mx.gluon.nn.Dense(3, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            return h, F.sum(h, axis=1)
+
+    net = TwoHead()
+    net.initialize()
+    cache = BucketedExecutorCache.from_block(net, buckets=(4,))
+    x = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+    h, s = cache(x)
+    assert np.asarray(h).shape == (3, 3) and np.asarray(s).shape == (3,)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(h).sum(axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dynamic batcher
+# ---------------------------------------------------------------------------
+def test_flush_on_full_does_not_wait():
+    """A full batch must flush immediately even under a huge max_wait."""
+    batcher = DynamicBatcher(lambda b: b * 2.0, max_batch_size=4,
+                             max_wait_ms=30_000.0, max_queue=16)
+    try:
+        t0 = time.monotonic()
+        futs = [batcher.submit(np.full((2,), i, np.float32))
+                for i in range(4)]
+        rows = [f.result(timeout=10) for f in futs]
+        assert time.monotonic() - t0 < 10      # nowhere near 30 s
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(r, np.full((2,), 2.0 * i))
+        assert batcher.metrics.batches == 1    # one full batch, no splits
+        assert batcher.metrics.mean_batch_occupancy() == 4.0
+    finally:
+        batcher.close()
+
+
+def test_flush_on_timeout_serves_partial_batch():
+    """A lone request must go out after ~max_wait_ms, not wait for a
+    full batch that never forms."""
+    batcher = DynamicBatcher(lambda b: b + 1.0, max_batch_size=8,
+                             max_wait_ms=30.0, max_queue=16)
+    try:
+        fut = batcher.submit(np.zeros((2,), np.float32))
+        np.testing.assert_allclose(fut.result(timeout=10), np.ones((2,)))
+        assert batcher.metrics.batches == 1
+        assert batcher.metrics.mean_batch_occupancy() == 1.0
+    finally:
+        batcher.close()
+
+
+def _blocked_batcher(release, **kwargs):
+    def runner(batch):
+        release.wait(timeout=30)
+        return batch * 1.0
+
+    return DynamicBatcher(runner, **kwargs)
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+def test_backpressure_rejects_with_retry_after():
+    release = threading.Event()
+    batcher = _blocked_batcher(release, max_batch_size=2, max_wait_ms=1.0,
+                               max_queue=3)
+    try:
+        first = batcher.submit(np.zeros(2, np.float32))
+        _wait_until(lambda: batcher.queue_depth == 0)   # worker holds it
+        queued = [batcher.submit(np.zeros(2, np.float32))
+                  for _ in range(3)]                    # queue now full
+        with pytest.raises(QueueFullError) as ei:
+            batcher.submit(np.zeros(2, np.float32))
+        assert ei.value.retry_after > 0
+        assert batcher.metrics.rejected == 1
+        release.set()                                   # unclog
+        for f in [first] + queued:
+            f.result(timeout=10)
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_graceful_drain_answers_queued_then_refuses():
+    release = threading.Event()
+    batcher = _blocked_batcher(release, max_batch_size=2, max_wait_ms=1.0,
+                               max_queue=16)
+    try:
+        futs = [batcher.submit(np.full(2, i, np.float32)) for i in range(5)]
+        release.set()
+        assert batcher.drain(timeout=15)
+        assert all(f.done() for f in futs)
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(0), np.full(2, float(i)))
+        with pytest.raises(ServerClosedError):
+            batcher.submit(np.zeros(2, np.float32))
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_close_fails_queued_requests():
+    release = threading.Event()
+    batcher = _blocked_batcher(release, max_batch_size=1, max_wait_ms=1.0,
+                               max_queue=16)
+    first = batcher.submit(np.zeros(2, np.float32))
+    _wait_until(lambda: batcher.queue_depth == 0)
+    queued = batcher.submit(np.ones(2, np.float32))
+    # release AFTER close has failed the queue (but while close joins the
+    # worker), so the worker cannot race in and serve `queued` first
+    threading.Timer(0.2, release.set).start()
+    batcher.close()
+    first.result(timeout=10)                   # in-flight batch still lands
+    with pytest.raises(ServerClosedError):
+        queued.result(timeout=10)
+
+
+def test_submit_signature_mismatch_rejected_up_front():
+    batcher = DynamicBatcher(lambda b: b, max_batch_size=4, max_queue=8)
+    try:
+        batcher.expect_features((4,), "float32")
+        with pytest.raises(ValueError):
+            batcher.submit(np.zeros((5,), np.float32))   # wrong shape
+        with pytest.raises(ValueError):
+            batcher.submit(np.zeros((4,), np.float64))   # wrong dtype
+        np.testing.assert_allclose(
+            batcher.submit(np.arange(4, dtype=np.float32)).result(10),
+            np.arange(4.0))
+    finally:
+        batcher.close()
+
+
+def test_bad_runner_output_fails_caller_not_worker():
+    """A runner whose output rows don't cover the batch must fail those
+    futures — and the worker thread must survive to serve the next
+    request."""
+    calls = {"n": 0}
+
+    def runner(batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return np.zeros((0, 2), np.float32)   # no rows for the batch
+        return batch
+
+    batcher = DynamicBatcher(runner, max_batch_size=1, max_wait_ms=1.0)
+    try:
+        with pytest.raises(IndexError):
+            batcher.submit(np.zeros(2, np.float32)).result(timeout=10)
+        np.testing.assert_allclose(                # worker still alive
+            batcher.submit(np.ones(2, np.float32)).result(timeout=10),
+            np.ones(2))
+    finally:
+        batcher.close()
+
+
+def test_server_rejects_config_for_prebuilt_cache():
+    cache = BucketedExecutorCache.from_block(_dense(), buckets=(1, 2))
+    with pytest.raises(ValueError):
+        ModelServer(cache, buckets=(4, 8))   # silently ignored before
+    srv = ModelServer(cache)                 # no overrides: fine
+    srv.close()
+
+
+def test_runner_failure_propagates_to_futures():
+    def runner(batch):
+        raise RuntimeError("boom")
+
+    batcher = DynamicBatcher(runner, max_batch_size=2, max_wait_ms=1.0)
+    try:
+        fut = batcher.submit(np.zeros(2, np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=10)
+        # the worker survives a failing batch and serves the next one
+        ok = DynamicBatcher(lambda b: b, max_batch_size=2, max_wait_ms=1.0)
+        try:
+            ok.submit(np.zeros(2, np.float32)).result(timeout=10)
+        finally:
+            ok.close()
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# ModelServer end to end
+# ---------------------------------------------------------------------------
+def test_server_concurrent_clients_match_unbatched():
+    """Acceptance: concurrent clients through the batcher produce results
+    identical to unbatched Block.__call__, batch occupancy > 1, and
+    exactly one compile per shape bucket (hits on repeat traffic)."""
+    net = _dense()
+    srv = ModelServer(net, buckets=(1, 2, 4, 8), max_wait_ms=20.0,
+                      max_queue=256)
+    try:
+        srv.warmup((4,), "float32")
+        rs = np.random.RandomState(2)
+        xs = rs.rand(48, 4).astype(np.float32)
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            futs = list(pool.map(srv.submit, xs))
+        got = np.stack([f.result(timeout=30) for f in futs])
+        ref = net(mx.nd.array(xs)).asnumpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+        stats = srv.stats()
+        assert stats["requests"] == 48
+        assert stats["batch_occupancy"] > 1.0
+        # one executable per bucket, compiled exactly once (at warmup)
+        assert stats["executor_cache"]["compiles"] == 4
+        assert stats["executor_cache"]["misses"] == 4
+        assert stats["executor_cache"]["hits"] == stats["batches"]
+        assert stats["latency_ms"]["p99"] >= stats["latency_ms"]["p50"] > 0
+    finally:
+        srv.close()
+
+
+def test_server_context_manager_drains():
+    net = _dense()
+    with ModelServer(net, buckets=(1, 2), max_wait_ms=5.0) as srv:
+        fut = srv.submit(np.zeros(4, np.float32))
+    assert fut.result(timeout=0).shape == (3,)   # drained on exit
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.zeros(4, np.float32))
+
+
+def test_server_max_batch_size_capped_by_buckets():
+    with pytest.raises(ValueError):
+        ModelServer(_dense(), buckets=(1, 2), max_batch_size=4)
+
+
+def test_export_for_serving_round_trip(tmp_path):
+    net = _dense()
+    x = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "net")
+    spec_file = net.export_for_serving(prefix, buckets=(1, 2))
+    spec = json.load(open(spec_file))
+    assert spec["inputs"] == [{"name": "data", "features": [4],
+                               "dtype": "float32"}]
+    srv = ModelServer.from_exported(prefix, max_wait_ms=1.0)
+    try:
+        # warmed up: every recorded bucket already compiled
+        assert [k[0] for k in srv.compiled_signatures()] == [1, 2]
+        got = np.stack([srv.predict(row) for row in x])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.close()
+
+
+def test_from_checkpoint_native_reader(tmp_path):
+    from incubator_mxnet_tpu import native
+
+    if native.lib() is None:
+        pytest.skip("native IO library unavailable (no toolchain)")
+    net = _dense()
+    x = np.random.RandomState(4).rand(3, 4).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    ckpt = str(tmp_path / "net.params")
+    net.save_parameters(ckpt)
+
+    fresh = mx.gluon.nn.Dense(3, in_units=4)
+    fresh.initialize()
+    srv = ModelServer.from_checkpoint(fresh, ckpt, use_native=True,
+                                      buckets=(1, 2, 4), max_wait_ms=1.0)
+    try:
+        got = np.stack([srv.predict(row) for row in x])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.close()
+
+
+def test_metrics_percentiles_and_snapshot():
+    m = ServingMetrics("m", window=100)
+    for v in range(1, 101):                    # 1..100 ms
+        m.observe_latency(v / 1e3)
+    # nearest-rank: p50 of 1..100 is exactly the 50th value
+    assert m.latency_ms(50) == pytest.approx(50)
+    assert m.latency_ms(99) == pytest.approx(99)
+    two = ServingMetrics("two")
+    two.observe_latency(0.001)
+    two.observe_latency(0.002)
+    assert two.latency_ms(50) == pytest.approx(1.0)   # not the upper rank
+    m.observe_batch(4)
+    m.observe_batch(2)
+    snap = m.snapshot()
+    assert snap["batch_occupancy"] == 3.0
+    assert snap["requests"] == 100
+    assert ServingMetrics("empty").snapshot()["latency_ms"]["p50"] == 0.0
+
+
+def test_serving_scopes_reach_profiler_trace(tmp_path):
+    from incubator_mxnet_tpu import profiler
+
+    net = _dense()
+    srv = ModelServer(net, buckets=(1,), max_wait_ms=1.0, name="prof")
+    try:
+        profiler.set_config(filename=str(tmp_path / "trace.json"))
+        profiler.set_state("run")
+        srv.predict(np.zeros(4, np.float32))
+        profiler.set_state("stop")
+        names = {ev["name"] for ev in profiler._state["records"]}
+        assert any(n.startswith("serving::prof::") for n in names)
+        assert "serving/prof/batch_size" in names
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# corrupt-checkpoint regressions (native reader hardening)
+# ---------------------------------------------------------------------------
+def _native_or_skip():
+    from incubator_mxnet_tpu import native
+
+    if native.lib() is None:
+        pytest.skip("native IO library unavailable (no toolchain)")
+    return native
+
+
+def _write_params(tmp_path, name="ckpt.params"):
+    from incubator_mxnet_tpu import ndarray as nd
+
+    path = str(tmp_path / name)
+    nd.save(path, {"w": nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))})
+    return path
+
+
+def _central_dir_offset(buf):
+    """Absolute offset of the first central-directory record."""
+    eocd = buf.rfind(b"PK\x05\x06")
+    assert eocd > 0
+    cd_rel = struct.unpack("<I", buf[eocd + 16:eocd + 20])[0]
+    return 8 + cd_rel                          # 8 = MXTPU001 magic
+
+
+def _patch(path, off, data):
+    with open(path, "r+b") as f:
+        f.seek(off)
+        f.write(data)
+
+
+def test_corrupt_cd_name_length_no_oob(tmp_path):
+    """Huge central-directory nlen used to drive a ~64KB heap OOB read;
+    hardened parser must stop cleanly instead."""
+    native = _native_or_skip()
+    path = _write_params(tmp_path)
+    cd = _central_dir_offset(open(path, "rb").read())
+    _patch(path, cd + 28, struct.pack("<H", 0xFFFF))   # nlen
+    assert native.native_params_load(path) == {}
+
+
+def test_corrupt_usize_underflow_no_huge_alloc(tmp_path):
+    """usize smaller than the npy header used to wrap data_len to a
+    multi-exabyte size; the member must be skipped instead."""
+    native = _native_or_skip()
+    path = _write_params(tmp_path)
+    cd = _central_dir_offset(open(path, "rb").read())
+    # central csize+usize (stored: must stay equal to pass the method
+    # check) -> 5 bytes, far below the npy header length
+    _patch(path, cd + 20, struct.pack("<II", 5, 5))
+    assert native.native_params_load(path) == {}
+
+
+def test_corrupt_data_past_eof_rejected(tmp_path):
+    """data_off + data_len beyond the file must be a clean parse skip,
+    not a short read into a bogus entry."""
+    native = _native_or_skip()
+    path = _write_params(tmp_path)
+    buf = open(path, "rb").read()
+    cd = _central_dir_offset(buf)
+    big = len(buf) + 4096
+    _patch(path, cd + 20, struct.pack("<II", big, big))
+    assert native.native_params_load(path) == {}
+
+
+def test_corrupt_npy_v2_header_length_no_huge_alloc(tmp_path):
+    """A forged npy v2 header length (u32, up to ~4 GB) must be rejected
+    before the header buffer is allocated — not bad_alloc mid-parse."""
+    native = _native_or_skip()
+    path = _write_params(tmp_path)
+    buf = open(path, "rb").read()
+    npy = buf.find(b"\x93NUMPY")
+    assert npy > 0
+    # version 1 -> 2 (u32 header length field) with a huge length
+    _patch(path, npy + 6, b"\x02\x00" + struct.pack("<I", 0xFFFFFF00))
+    assert native.native_params_load(path) == {}
+
+
+def test_corrupt_files_still_leave_valid_members_readable(tmp_path):
+    """Hardening must not break the happy path: an intact file written
+    by the Python side still round-trips through the C reader."""
+    native = _native_or_skip()
+    path = _write_params(tmp_path)
+    got = native.native_params_load(path)
+    np.testing.assert_array_equal(
+        got["w"], np.arange(12, dtype=np.float32).reshape(3, 4))
+
+
+def test_bf16_typeflag_code_is_12(tmp_path):
+    """bf16 travels as reference TypeFlag 12 (kBfloat16) — 7 is kBool."""
+    import ctypes
+
+    import ml_dtypes
+
+    native = _native_or_skip()
+    from incubator_mxnet_tpu import ndarray as nd
+
+    path = str(tmp_path / "bf.params")
+    arr = np.random.RandomState(5).rand(2, 3).astype(ml_dtypes.bfloat16)
+    nd.save(path, {"b": nd.array(arr, dtype="bfloat16")})
+
+    l = native.lib()
+    h = l.mxio_params_open(path.encode())
+    assert h
+    try:
+        assert l.mxio_params_count(h) == 1
+        dt = ctypes.c_int()
+        shape = (ctypes.c_int64 * 32)()
+        nb = ctypes.c_int64()
+        ndim = l.mxio_params_info(h, 0, ctypes.byref(dt), shape, 32,
+                                  ctypes.byref(nb))
+        assert ndim == 2 and dt.value == 12
+    finally:
+        l.mxio_params_close(h)
+    # python round trip agrees bit-for-bit
+    got = native.native_params_load(path)
+    assert got["b"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got["b"].view(np.uint16),
+                                  arr.view(np.uint16))
+    # and the C writer emits code 12 readably too
+    wpath = str(tmp_path / "bfw.params")
+    native.native_params_save(wpath, {"b": arr})
+    again = native.native_params_load(wpath)
+    np.testing.assert_array_equal(again["b"].view(np.uint16),
+                                  arr.view(np.uint16))
+
+
+def test_ndim_overflow_guard(tmp_path):
+    """>32-dim members raise a clean IOError from native_params_load
+    (mirrors the C++ Checkpoint::Load guard) instead of reshaping
+    against a truncated shape buffer."""
+    native = _native_or_skip()
+    try:
+        arr = np.zeros((1,) * 33, np.float32)
+    except ValueError:
+        pytest.skip("numpy build caps ndim below 33")
+    path = str(tmp_path / "deep.params")
+    import io
+
+    buf = io.BytesIO()                 # zip offsets must be magic-relative
+    np.savez(buf, deep=arr)
+    with open(path, "wb") as f:
+        f.write(b"MXTPU001")
+        f.write(buf.getvalue())
+    with pytest.raises(IOError):
+        native.native_params_load(path)
