@@ -484,6 +484,50 @@ def run_op(mx, name, batch, iters):
     return fwd_ms, bwd_ms
 
 
+def run_train_step(fused, nparams=50, shape=(64, 64), iters=30):
+    """Eager-Gluon train step (steps/s): one Trainer.step over ``nparams``
+    dense parameters with synthetic grads — fused (one donated executable)
+    vs per-param (one jitted dispatch per parameter)."""
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import Parameter
+
+    rng = np.random.RandomState(0)
+    params = []
+    for k in range(nparams):
+        p = Parameter(name=f"p{k}", shape=shape)
+        p.initialize(init="zeros")
+        p.set_data(mx.nd.array(rng.rand(*shape).astype(np.float32)))
+        params.append(p)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    trainer.fused_step(fused)
+    grads = [jnp.asarray(rng.rand(*shape).astype(np.float32))
+             for _ in params]
+
+    def one_step():
+        for p, g in zip(params, grads):
+            p._data._grad._data = g
+            p._data._grad_fresh = True
+        trainer.step(1)
+
+    one_step()                                   # compile + warm
+    for p in params:
+        p.data().asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one_step()
+    for p in params:                              # async barrier
+        p.data().asnumpy()
+    step_ms = (time.perf_counter() - t0) / iters * 1e3
+    return step_ms
+
+
+_TRAIN_STEP_ROWS = ("gluon_train_step[fused]", "gluon_train_step[perparam]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default="",
@@ -499,7 +543,8 @@ def main(argv=None):
     all_ops = registry.list_ops()
     wanted = [o for o in args.ops.split(",") if o] or all_ops
     covered = [o for o in wanted if o in ARGSPECS]
-    skipped = [o for o in wanted if o not in ARGSPECS]
+    skipped = [o for o in wanted
+               if o not in ARGSPECS and o not in _TRAIN_STEP_ROWS]
 
     rows = []
     for name in covered:
@@ -510,6 +555,20 @@ def main(argv=None):
         except Exception as e:  # keep sweeping
             rows.append({"op": name, "error": str(e)[:120]})
     rows.sort(key=lambda r: r.get("fwd_ms") or 0, reverse=True)
+
+    # whole-trainer step rows (fused-vs-per-param speedup lands in the
+    # BENCH json next to the per-op table)
+    step_rows = [n for n in _TRAIN_STEP_ROWS
+                 if not args.ops or n in wanted]
+    for name in step_rows:
+        try:
+            ms = run_train_step(fused="fused" in name,
+                                iters=max(args.iters, 10))
+            rows.append({"op": name, "fwd_ms": round(ms, 4),
+                         "bwd_ms": None,
+                         "steps_per_s": round(1e3 / ms, 2)})
+        except Exception as e:  # keep sweeping
+            rows.append({"op": name, "error": str(e)[:120]})
 
     if args.json:
         print(json.dumps({"results": rows, "skipped": skipped}, indent=1))

@@ -6,23 +6,297 @@ decision, ``step`` (rescale → allreduce → optimizer update), ``allreduce_gra
 / ``update`` split for gradient accumulation, learning-rate plumbing, and
 optimizer-state save/load.
 
-TPU-native redesign: a Parameter is one logical array, so the reference's
-cross-copy reduction disappears; what remains is (a) cross-process allreduce
-via the kvstore facade when running multi-host, and (b) per-parameter
-jit-fused update kernels (see optimizer module). Comm/compute overlap comes
-from XLA async collectives when the step runs inside ``parallel`` sharded
-training instead of from engine scheduling.
+TPU-native redesign: the reference hides per-op dispatch cost behind the
+threaded dependency engine; eager jax has no such engine, so a per-parameter
+update loop pays one XLA dispatch per parameter per step. The **FusedStep**
+engine below collapses the whole step — rescale + clip + optimizer rule for
+EVERY parameter, and (multi-host) the gradient allreduce — into ONE jitted
+executable with weight/state buffers donated (in-place in HBM). ``step``
+takes the fused path automatically whenever the optimizer exposes a
+functional core (``Optimizer.update_fn``) and all grads are dense, and falls
+back transparently (sparse grads, ``update_on_kvstore``, fp16 master
+weights, amp loss scaling hooks) to the per-parameter path.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Union
 
+import jax
+import jax.numpy as jnp
+
 from .. import optimizer as opt_mod
+from .. import profiler
 from ..kvstore import KVStore
 from ..kvstore import create as kv_create
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
+
+_STALE_GRAD_MSG = (
+    "Gradient of Parameter `{name}` has not been updated "
+    "by backward since last `step`. This could mean a bug in "
+    "your model that made it only use a subset of the "
+    "Parameters for this iteration. If you are intentionally "
+    "only using a subset, call step with "
+    "ignore_stale_grad=True (reference Trainer semantics)")
+
+
+class FusedStep:
+    """Whole-model optimizer update in one donated XLA executable.
+
+    One compiled executable per (optimizer class, hyper-key, param
+    treedef/shapes/dtypes, comm mode) applies the functional core of every
+    parameter at once: XLA fuses the 160-kernel ResNet-50 update into a
+    handful of fused loops, weights and optimizer states are donated
+    (updated in place in HBM), and per-step scalars (lr/wd/t) ride in as
+    traced args — O(1) dispatches per step regardless of parameter count.
+
+    Multi-host, the gradient allreduce moves INSIDE the same executable
+    (payload prep honors the kvstore ``compression`` hooks; dequantize +
+    sum + update lower into one XLA computation so comms overlap the
+    math). NOTE: in that mode ``param.grad()`` keeps each rank's LOCAL
+    gradient after the step — the reduced sum only exists in-graph
+    (documented in docs/TRAINING.md). ``shard_update=True`` instead shards optimizer state ZeRO-1
+    style (arXiv:2004.13336): each rank keeps states for and updates only
+    ``index % num_workers == rank`` parameters, then one batched
+    collective rebuilds the replicated weights.
+    """
+
+    def __init__(self, trainer: "Trainer"):
+        self._trainer = trainer
+        self._cache: Dict[tuple, object] = {}
+        self._zeros_cache: Dict[tuple, jax.Array] = {}
+        self.shard_update = False
+        # set by Trainer.step when the cross-process allreduce should fuse
+        # into the executable; consumed (and cleared) by run()
+        self.pending_allreduce = False
+        self.dispatch_count = 0      # executable invocations (tests/bench)
+        self.last_fallback: Optional[str] = None
+
+    # -- engagement ---------------------------------------------------------
+    def wants_ingraph_allreduce(self) -> bool:
+        tr = self._trainer
+        return (tr._distributed and tr._kvstore is not None
+                and tr._kvstore._updater is None
+                and not self.shard_update
+                and getattr(tr, "_amp_loss_scaler", None) is None
+                and getattr(tr._updater.optimizer, "_has_fused_core", False))
+
+    def _fallback(self, why: str) -> bool:
+        self.last_fallback = why
+        return False
+
+    # -- the step -----------------------------------------------------------
+    def run(self, ignore_stale_grad: bool = False) -> bool:
+        """Try one fused step. Returns True when the fused executable ran
+        (or there was nothing to update); False means the caller must take
+        the per-parameter path. No state is mutated before the commit
+        point, so a False return leaves the trainer exactly as found —
+        except that a pending in-graph allreduce is discharged through the
+        kvstore so the eager path still sees reduced grads."""
+        tr = self._trainer
+        ingraph = self.pending_allreduce
+        self.pending_allreduce = False
+        try:
+            ok = self._run(tr, ingraph, ignore_stale_grad)
+        except UserWarning:
+            if ingraph:
+                # stale-grad raise: match the eager ordering (symmetric
+                # allreduce first, THEN the rank-local raise) so ranks
+                # that do proceed see reduced grads, not a missing
+                # collective
+                tr._allreduce_grads()
+            raise
+        if not ok and ingraph:
+            tr._allreduce_grads()
+        return ok
+
+    def _run(self, tr: "Trainer", ingraph: bool,
+             ignore_stale_grad: bool) -> bool:
+        from ..ndarray.sparse import RowSparseNDArray
+
+        upd = tr._updater
+        opt = upd.optimizer
+        if not getattr(opt, "_has_fused_core", False):
+            return self._fallback("optimizer has no functional core")
+        if tr._kvstore is not None and tr._update_on_kvstore:
+            return self._fallback("update_on_kvstore")
+
+        if ignore_stale_grad and (ingraph or (self.shard_update
+                                              and tr._distributed)):
+            # freshness is a per-process predicate: ranks could disagree on
+            # the entry set and build mismatched collectives (hang). The
+            # decision to fall back must itself be rank-independent, so key
+            # it on the flag alone; the eager path reduces over ALL grads
+            return self._fallback("ignore_stale_grad with cross-process step")
+        # collect — mirrors Trainer._update, mutating nothing yet
+        entries = []
+        for i, p in enumerate(tr._params):
+            if p.grad_req == "null" or p._data is None \
+                    or p._data._grad is None:
+                continue
+            if not p._data._grad_fresh:
+                if ignore_stale_grad:
+                    continue
+                raise UserWarning(_STALE_GRAD_MSG.format(name=p.name))
+            entries.append((i, p))
+        if not entries:
+            return True
+        for i, p in entries:
+            if isinstance(p._data._grad, RowSparseNDArray):
+                return self._fallback("row-sparse gradient")
+            if opt.multi_precision and p.data().dtype in (jnp.float16,
+                                                          jnp.bfloat16):
+                return self._fallback("multi_precision master weights")
+            st = upd.states.get(i)
+            if isinstance(st, tuple) and len(st) == 2 \
+                    and isinstance(st[0], jax.Array) \
+                    and st[0].dtype == jnp.float32 \
+                    and p.data().dtype in (jnp.float16, jnp.bfloat16):
+                return self._fallback("existing fp32 master state")
+
+        # ---- commit point: from here the fused step WILL run ----
+        size = tr._kvstore.num_workers if tr._kvstore is not None else 1
+        rank = tr._kvstore.rank if tr._kvstore is not None else 0
+        shard = self.shard_update and tr._distributed and size > 1
+        for i, p in entries:
+            p._data._grad_fresh = False
+            opt._update_count(i)
+        if shard:
+            # ZeRO-1: this rank owns (keeps state for, updates) a 1/size
+            # slice of the parameter list; grads were already reduced by
+            # step()'s batched host collective
+            mine = [(i, p) for i, p in entries if i % size == rank]
+        else:
+            mine = entries
+        for i, p in mine:
+            if i not in upd.states:
+                upd.states[i] = opt.create_state_multi_precision(i, p.data())
+        lrs = tuple(opt._get_lr(i) for i, _ in mine)
+        wds = tuple(opt._get_wd(i) for i, _ in mine)
+        ts = tuple(float(opt._index_update_count[i]) for i, _ in mine)
+
+        ws = tuple(p.data()._data for _, p in mine)
+        gs = tuple(p._data._grad._data for _, p in mine)
+        states = tuple(opt._pack_state(upd.states[i]) for i, _ in mine)
+
+        compression = getattr(tr._kvstore, "_compression", None) \
+            if ingraph else None
+        compressor = getattr(tr._kvstore, "_compressor", None) \
+            if ingraph else None
+        multiproc = ingraph and size > 1
+        if ingraph:
+            from ..parallel.collectives import make_fused_allreduce
+
+            gs, reduce_fn = make_fused_allreduce(
+                list(gs), compression=compression, compressor=compressor,
+                keys=[i for i, _ in mine])
+            gs = tuple(gs)
+        else:
+            reduce_fn = None
+
+        cache_key = (type(opt).__name__, opt._hyper_key(),
+                     tuple((i, p.shape, str(p.data().dtype),
+                            tuple((s.shape, str(s.dtype)) for s in st))
+                           for (i, p), st in zip(mine, states)),
+                     multiproc, compression,
+                     # the 2bit threshold is baked into the traced
+                     # reduce_fn — a changed value must recompile
+                     getattr(compressor, "threshold", None), shard)
+        jfn = self._cache.get(cache_key)
+        if jfn is None:
+            jfn = self._build(opt, len(mine), reduce_fn, multiproc)
+            self._cache[cache_key] = jfn
+
+        if multiproc:
+            from ..parallel.collectives import replicate_across_processes
+
+            ws = jax.tree_util.tree_map(replicate_across_processes, ws)
+            states = jax.tree_util.tree_map(replicate_across_processes,
+                                            states)
+            # scalars (and the rng key below) must live on the same mesh
+            # as the global ws/gs/states — a host-local array in a
+            # cross-process computation is rejected by jax
+            _rep = replicate_across_processes
+        else:
+            def _rep(x):
+                return x
+
+        args = [ws, gs, states,
+                tuple(_rep(opt._as_f32(v)) for v in lrs),
+                tuple(_rep(opt._as_f32(v)) for v in wds),
+                tuple(_rep(opt._as_f32(v)) for v in ts),
+                _rep(opt._as_f32(float(opt.rescale_grad)))]
+        if opt._needs_rng:
+            from .. import random as _random
+
+            args.append(_rep(_random.next_key()))
+        with profiler.scope("gluon.fused_step"):
+            new_ws, new_states = jfn(*args)
+        self.dispatch_count += 1
+
+        if multiproc:
+            new_ws = jax.tree_util.tree_map(
+                lambda a: a.addressable_data(0), new_ws)
+            new_states = jax.tree_util.tree_map(
+                lambda a: a.addressable_data(0), new_states)
+        for (i, p), nw, nst in zip(mine, new_ws, new_states):
+            p._data._set_data(nw)
+            upd.states[i] = opt._unpack_state(tuple(nst))
+
+        if shard:
+            # rebuild replicated weights: owner contributes its fresh
+            # update, everyone else zeros — one batched collective (zero
+            # buffers are cached per shape/dtype, not re-allocated each
+            # step)
+            from ..parallel.collectives import allreduce_arrays
+
+            owned = {i for i, _ in mine}
+            payload = [p.data()._data if i in owned
+                       else self._zeros(p.data()._data)
+                       for i, p in entries]
+            for (i, p), w in zip(entries, allreduce_arrays(payload)):
+                p._data._set_data(w)
+        return True
+
+    def _zeros(self, like) -> jax.Array:
+        key = (tuple(like.shape), str(like.dtype))
+        z = self._zeros_cache.get(key)
+        if z is None:
+            z = jnp.zeros(like.shape, like.dtype)
+            self._zeros_cache[key] = z
+        return z
+
+    def _build(self, opt, n: int, reduce_fn, multiproc: bool):
+        """Compile the whole-model executable. Weights (arg 0) and states
+        (arg 2) are donated — in-place in HBM; grads (arg 1) are NOT, the
+        buffers stay user-readable after the step."""
+
+        def fused(ws, gs, states, lrs, wds, ts, rescale, *rng):
+            if reduce_fn is not None:
+                gs = reduce_fn(gs)
+            keys = jax.random.split(rng[0], n) if rng else (None,) * n
+            new_ws, new_states = [], []
+            for w, g, st, lr, wd, t, k in zip(ws, gs, states, lrs, wds,
+                                              ts, keys):
+                g = g * rescale.astype(g.dtype)
+                if k is not None:
+                    nw, nst = opt.update_fn(w, g, st, lr, wd, t, key=k)
+                else:
+                    nw, nst = opt.update_fn(w, g, st, lr, wd, t)
+                new_ws.append(nw)
+                new_states.append(nst)
+            return tuple(new_ws), tuple(new_states)
+
+        kwargs = {}
+        if multiproc:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.collectives import _process_mesh
+
+            replicated = NamedSharding(_process_mesh(), PartitionSpec())
+            kwargs["out_shardings"] = (replicated, replicated)
+        return jax.jit(fused, donate_argnums=(0, 2), **kwargs)
 
 
 class Trainer:
@@ -56,7 +330,10 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._kvstore_spec = kvstore
+        self._compression_params = compression_params
         self._distributed = False
+        self._fused = FusedStep(self)
+        self._fused_mode = True      # auto: fuse whenever possible
 
     # -- kvstore ------------------------------------------------------------
     def _init_kvstore(self):
@@ -67,6 +344,8 @@ class Trainer:
             self._kvstore = spec
         else:
             self._kvstore = kv_create(spec)
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(self._compression_params)
         self._distributed = (self._kvstore is not None
                              and self._kvstore.num_workers > 1)
         if self._update_on_kvstore is None:
@@ -89,18 +368,45 @@ class Trainer:
     def optimizer(self):
         return self._optimizer
 
+    def fused_step(self, enabled: bool = True,
+                   shard_update: bool = False) -> "Trainer":
+        """Configure the FusedStep engine: ``fused_step(False)`` pins the
+        per-parameter path; ``fused_step(shard_update=True)`` additionally
+        shards optimizer state/update across replicas (ZeRO-1)."""
+        self._fused_mode = bool(enabled)
+        self._fused.shard_update = bool(shard_update)
+        return self
+
     # -- stepping -----------------------------------------------------------
     def step(self, batch_size: int, ignore_stale_grad: bool = False):
-        """Rescale by 1/batch_size, allreduce (if distributed), update."""
+        """Rescale by 1/batch_size, allreduce (if distributed), update —
+        fused into one executable whenever possible."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        if self._fused_mode and self._fused.wants_ingraph_allreduce():
+            # the cross-process sum lowers into the fused executable; if
+            # run() falls back it discharges the allreduce via the kvstore
+            self._fused.pending_allreduce = True
+        elif not self._update_on_kvstore:
+            # update-on-kvstore pushes reduce server-side; a prior
+            # allreduce would double-count
+            self._allreduce_grads()
+        try:
+            self._update(ignore_stale_grad)
+        finally:
+            self._fused.pending_allreduce = False
 
     def allreduce_grads(self):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            # reference Trainer semantics: with the optimizer on the
+            # kvstore, push IS the reduction — a separate allreduce would
+            # run the updater prematurely
+            raise RuntimeError(
+                "allreduce_grads() is not supported when parameters are "
+                "updated on kvstore (update_on_kvstore=True)")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -123,6 +429,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False):
+        if self._fused_mode and self._fused.run(ignore_stale_grad):
+            return
+        kv_batch = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -132,19 +441,23 @@ class Trainer:
                 # gradient not touched by backward since the last step
                 if ignore_stale_grad:
                     continue
-                raise UserWarning(
-                    f"Gradient of Parameter `{p.name}` has not been updated "
-                    "by backward since last `step`. This could mean a bug in "
-                    "your model that made it only use a subset of the "
-                    "Parameters for this iteration. If you are intentionally "
-                    "only using a subset, call step with "
-                    "ignore_stale_grad=True (reference Trainer semantics)")
-            p._data._grad_fresh = False
+                raise UserWarning(_STALE_GRAD_MSG.format(name=p.name))
             if self._kvstore is not None and self._update_on_kvstore:
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, out=p.data())
+                # freshness is cleared at the batch commit below, so a
+                # stale-grad raise mid-collection loses nothing
+                kv_batch.append((i, p))
             else:
+                p._data._grad_fresh = False
                 self._updater(i, p.grad(), p.data())
+        if kv_batch:
+            # one batched fused-collective call instead of per-parameter
+            # push/pull pairs (the updater on the kvstore applies the rule)
+            for i, p in kv_batch:
+                p._data._grad_fresh = False
+            self._kvstore.pushpull_list(
+                [i for i, _ in kv_batch],
+                [p.grad() for _, p in kv_batch],
+                [p.data() for _, p in kv_batch])
 
     # -- states -------------------------------------------------------------
     def save_states(self, fname: str):

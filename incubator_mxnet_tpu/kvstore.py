@@ -202,9 +202,16 @@ class KVStore:
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         """Fused allreduce (reference ``MXKVStorePushPullEx``): sum the
         pushed values and write the result to ``out`` (grads in, summed
-        grads out — no optimizer involved)."""
+        grads out). With an updater set (update-on-kvstore) this is
+        push (updater applies the rule into the store) + pull — the
+        batched ``Trainer._update`` path."""
         from .ndarray.sparse import RowSparseNDArray
 
+        if self._updater is not None:
+            self.push(key, value, priority)
+            if out is not None:
+                self.pull(key, out=out, priority=priority)
+            return
         keys, _ = self._key_list(key)
         vals = self._val_list(value, len(keys))
         if out is None:
@@ -348,8 +355,32 @@ class KVStoreDist(KVStore):
         round-trips)."""
         from .ndarray.sparse import RowSparseNDArray
 
-        if self._size <= 1 or self._updater is not None:
+        if self._size <= 1:
             return super().pushpull_list(keys, values, outs, priority)
+        if self._updater is not None:
+            # update-on-kvstore batched: ONE cross-process collective for
+            # every gradient, then the updater applies the rule per key
+            # (vs. one allreduce per push in the per-key path). Sparse
+            # values keep the per-key path (mask-union semantics).
+            vlists = [v if isinstance(v, (list, tuple)) else [v]
+                      for v in values]
+            if any(isinstance(vv, RowSparseNDArray)
+                   for vl in vlists for vv in vl):
+                return super().pushpull_list(keys, values, outs, priority)
+            from .parallel.collectives import allreduce_arrays
+
+            local = [KVStore._reduce(self, vl) for vl in vlists]
+            summed = allreduce_arrays([a._data for a in local],
+                                      compression=self._compression,
+                                      compressor=self._compressor,
+                                      keys=list(keys))
+            for k, s, a in zip(keys, summed, local):
+                self._updater(k, NDArray(jnp.asarray(s, a.dtype),
+                                         ctx=a.ctx), self._store[k])
+            for k, o in zip(keys, outs):
+                if o is not None:
+                    self.pull(k, out=o, priority=priority)
+            return
         aggs = []
         for v in values:
             vlist = v if isinstance(v, (list, tuple)) else [v]

@@ -8,17 +8,27 @@ schedulers, and ``multi_precision`` (fp32 master weights for fp16/bf16
 params).
 
 TPU-native redesign: the reference implements each update as a fused CUDA
-kernel (``sgd_mom_update`` etc.). Here each update rule is a pure jax function
-jitted once per (shape, dtype) — XLA fuses the whole update chain (rescale +
-clip + wd + rule) into one kernel, and donated buffers make it in-place in
-HBM, which is the ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` multi-tensor trick's
-moral equivalent.
+kernel (``sgd_mom_update`` etc.). Here each rule is a **pure functional
+core** ``update_fn(w, g, states, lr, wd, t) -> (w', states')`` — jax code
+with hyperparameters (momentum, betas, clip, ...) read off the optimizer
+at trace time. The same core backs two execution engines:
+
+* the per-parameter ``Optimizer._run`` path below (one jitted executable
+  per (rule, shape, dtype, hyper-key), donated weight+state buffers), and
+* ``gluon.trainer.FusedStep``, which stitches every parameter's core into
+  ONE donated executable per training step (the
+  ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` multi-tensor trick taken to its
+  limit: the whole model is one aggregation group).
+
+Because hyperparameters are closure state, every executable cache is keyed
+on ``_hyper_key()`` so a mid-training mutation (e.g. a momentum warm-up)
+recompiles instead of silently reusing a stale constant; per-step scalars
+(lr, wd, t, rescale_grad) ride in as traced args and never recompile.
 """
 
 from __future__ import annotations
 
-import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +58,13 @@ def create(name, **kwargs) -> "Optimizer":
 class Optimizer:
     """Base optimizer (reference ``mxnet.optimizer.Optimizer``)."""
 
+    # a rule with a functional core sets this; engines (``_run`` /
+    # ``FusedStep``) only engage where it is True
+    _has_fused_core = False
+    # SGLD-style rules that consume per-step randomness: the engine passes
+    # a PRNG ``key`` kwarg into ``update_fn``
+    _needs_rng = False
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  multi_precision=False, param_dict=None, begin_num_update=0):
@@ -67,6 +84,7 @@ class Optimizer:
         self._lr_mult: Dict[Any, float] = {}
         self._wd_mult: Dict[Any, float] = {}
         self._jit_cache: Dict[Any, Any] = {}
+        self._scalar_memo: Dict[float, jax.Array] = {}
 
     # -- schedules / multipliers -------------------------------------------
     def set_learning_rate(self, lr):
@@ -131,9 +149,58 @@ class Optimizer:
             return (master, self.create_state(index, weight))
         return self.create_state(index, weight)
 
+    # external state (None / bare array / tuple, per rule) <-> the flat
+    # tuple every engine traffics in. Rules whose external state IS a
+    # 1-tuple (RMSProp) override _unpack_state.
+    def _pack_state(self, state) -> Tuple:
+        if state is None:
+            return ()
+        if isinstance(state, tuple):
+            return state
+        return (state,)
+
+    def _unpack_state(self, states: Tuple):
+        if len(states) == 0:
+            return None
+        if len(states) == 1:
+            return states[0]
+        return tuple(states)
+
+    # -- functional core ----------------------------------------------------
+    def update_fn(self, w, g, states, lr, wd, t):
+        """Pure update rule: ``(w, g, states, lr, wd, t) -> (w', states')``.
+
+        ``states`` is the flat tuple from ``_pack_state``; ``lr``/``wd``/``t``
+        are traced f32 scalars (t = this parameter's update count, for
+        in-graph bias correction); hyperparameters are read from ``self`` at
+        trace time, so executables MUST be cache-keyed on ``_hyper_key()``.
+        ``g`` arrives already rescaled: engines apply ``rescale_grad`` as a
+        per-step traced scalar in their prologue (``Trainer.step`` mutates
+        it every step — scale/batch_size, amp loss scale — so baking it in
+        would recompile per step). The core adds clip + (rule-placed) wd —
+        the whole chain XLA fuses into one kernel.
+        """
+        raise NotImplementedError
+
+    def _clip_grad(self, w, g):
+        """Shared core prologue: grad cast + optional clip (the engine has
+        already applied the traced rescale)."""
+        g = g.astype(w.dtype)
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
     # -- update -------------------------------------------------------------
     def update(self, index, weight: NDArray, grad: NDArray, state):
-        raise NotImplementedError
+        """Generic per-parameter path over the functional core."""
+        if not self._has_fused_core:
+            raise NotImplementedError
+        self._update_count(index)
+        t = float(self._index_update_count[index])
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        states = self._pack_state(state)
+        new_states = self._run(weight, grad._data, states, lr, wd, t)
+        return self._unpack_state(new_states)
 
     # optimizers with a true row-sparse (lazy) update path override this
     _supports_sparse_grad = False
@@ -163,39 +230,72 @@ class Optimizer:
         return self.update(index, weight, grad, state)
 
     # -- jit plumbing --------------------------------------------------------
+    # attributes that are per-step inputs (traced or counters), NOT
+    # executable-defining hyperparameters — excluded from the cache key so
+    # a step counter tick, an lr schedule, an amp loss-scale change, or a
+    # partial final batch (Trainer.step rewrites rescale_grad every step)
+    # does not recompile
+    _NON_HYPER = frozenset(("lr", "wd", "rescale_grad",
+                            "num_update", "begin_num_update"))
+
     def _hyper_key(self) -> tuple:
-        """Every plain scalar attribute of the rule, as cache-key material
-        (closure-captured hyperparameters define the compiled executable)."""
+        """Every plain scalar hyperparameter of the rule, as cache-key
+        material (trace-time-read hyperparameters define the compiled
+        executable)."""
         return tuple(sorted(
             (k, v) for k, v in self.__dict__.items()
-            if not k.startswith("_")
+            if not k.startswith("_") and k not in self._NON_HYPER
             and isinstance(v, (int, float, bool, str, type(None)))))
 
-    def _run(self, key, fn, weight: NDArray, grad, state_arrays, scalars):
-        """Jit-cached execution of an update rule.
+    def _as_f32(self, v: float) -> jax.Array:
+        """Memoized host->device scalar upload. A 160-parameter step sees
+        the same (lr, wd, t) floats 160 times; hoisting the conversion to
+        one upload per distinct value per step is satellite #1 of the
+        fused-step work."""
+        memo = self._scalar_memo
+        out = memo.get(v)
+        if out is None:
+            if len(memo) > 1024:       # schedulers emit unbounded values
+                memo.clear()
+            out = jnp.asarray(v, jnp.float32)
+            memo[v] = out
+        return out
 
-        ``fn(w, g, *states, **scalars) -> (new_w, new_states)``; scalars
-        (lr, wd, t, ...) are passed as traced args so one executable serves
-        every step and every layer of the same shape.
+    def _run(self, weight: NDArray, grad, states: Tuple, lr, wd, t):
+        """Jit-cached execution of the functional core for ONE parameter.
+
+        Weight and state buffers are donated (in-place update in HBM); the
+        grad buffer is NOT donated — it outlives the step
+        (user-inspectable). Scalars are passed as traced args so one
+        executable serves every step and every layer of the same shape.
         """
-        # ALL scalar hyperparameters are captured in the rule closures, so
-        # they are part of the executable identity: keying on them makes a
-        # changed value (rescale on a partial final batch, a momentum
+        # ALL trace-time hyperparameters are part of the executable
+        # identity: keying on them makes a changed value (a momentum
         # warm-up schedule mutating opt.momentum, …) recompile instead of
         # silently reusing the stale constant.
-        cache_key = (type(self).__name__, key, weight.shape,
-                     str(weight.dtype), tuple(s.shape for s in state_arrays),
+        cache_key = (type(self).__name__, weight.shape, str(weight.dtype),
+                     tuple((s.shape, str(s.dtype)) for s in states),
                      self._hyper_key())
         jfn = self._jit_cache.get(cache_key)
         if jfn is None:
-            # donate weight + states (in-place update in HBM); grad NOT
-            # donated — the grad buffer outlives the step (user-inspectable)
-            jfn = jax.jit(fn, donate_argnums=(0,) + tuple(
-                range(2, 2 + len(state_arrays))))
+            if self._needs_rng:
+                def wrapper(w, g, states, lr, wd, t, rescale, key):
+                    g = g * rescale.astype(g.dtype)
+                    return self.update_fn(w, g, states, lr, wd, t, key=key)
+            else:
+                def wrapper(w, g, states, lr, wd, t, rescale):
+                    g = g * rescale.astype(g.dtype)
+                    return self.update_fn(w, g, states, lr, wd, t)
+            jfn = jax.jit(wrapper, donate_argnums=(0, 2))
             self._jit_cache[cache_key] = jfn
-        new_w, new_states = jfn(weight._data, grad, *state_arrays,
-                                **{k: jnp.asarray(v, jnp.float32)
-                                   for k, v in scalars.items()})
+        args = [weight._data, grad, states,
+                self._as_f32(lr), self._as_f32(wd), self._as_f32(t),
+                self._as_f32(float(self.rescale_grad))]
+        if self._needs_rng:
+            from .. import random as _random
+
+            args.append(_random.next_key())
+        new_w, new_states = jfn(*args)
         weight._set_data(new_w)
         return new_states
 
@@ -203,7 +303,13 @@ class Optimizer:
     def __getstate__(self):
         d = self.__dict__.copy()
         d["_jit_cache"] = {}
+        d["_scalar_memo"] = {}
         return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.__dict__.setdefault("_jit_cache", {})
+        self.__dict__.setdefault("_scalar_memo", {})
 
 
 @register
@@ -212,6 +318,7 @@ class SGD(Optimizer):
     ``sgd_update``/``sgd_mom_update``/``mp_sgd_update`` kernels)."""
 
     _supports_sparse_grad = True
+    _has_fused_core = True
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
@@ -223,6 +330,14 @@ class SGD(Optimizer):
             return None
         return jnp.zeros(weight.shape, weight.dtype)
 
+    def update_fn(self, w, g, states, lr, wd, t):
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        if not states:
+            return w - lr.astype(w.dtype) * g, ()
+        (m,) = states
+        m = self.momentum * m - lr.astype(w.dtype) * g
+        return w + m, (m,)
+
     def _update_row_sparse(self, index, weight, grad, state):
         """Lazy SGD over a row-sparse grad (reference ``sgd_update`` /
         ``sgd_mom_update`` row_sparse paths with ``lazy_update=True``):
@@ -230,16 +345,15 @@ class SGD(Optimizer):
         momentum does NOT decay — the documented lazy semantics."""
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
-            self.momentum
+        clip, mom = self.clip_gradient, self.momentum
         has_mom = state is not None
         key = ("sgd_rsp", weight.shape, str(weight.dtype),
                int(grad._rdata.shape[0]), has_mom, self._hyper_key())
         jfn = self._jit_cache.get(key)
         if jfn is None:
-            def fn(w, rows, idx, m, lr, wd):
+            def fn(w, rows, idx, m, lr, wd, rescale):
                 wr = w[idx]
-                g = rows.astype(w.dtype) * rescale
+                g = rows.astype(w.dtype) * rescale.astype(w.dtype)
                 if clip is not None:
                     g = jnp.clip(g, -clip, clip)
                 g = g + wd.astype(w.dtype) * wr
@@ -253,8 +367,8 @@ class SGD(Optimizer):
             self._jit_cache[key] = jfn
         m_in = state if has_mom else jnp.zeros((0,), weight.dtype)
         new_w, new_m = jfn(weight._data, grad._rdata, grad._indices, m_in,
-                           jnp.asarray(lr, jnp.float32),
-                           jnp.asarray(wd, jnp.float32))
+                           self._as_f32(lr), self._as_f32(wd),
+                           self._as_f32(float(self.rescale_grad)))
         weight._set_data(new_w)
         return new_m if has_mom else None
 
@@ -265,39 +379,14 @@ class SGD(Optimizer):
             if self.lazy_update:
                 return self._update_row_sparse(index, weight, grad, state)
             grad = grad.todense()
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
-            self.momentum
-
-        if state is None:
-            def fn(w, g, lr, wd):
-                g = g.astype(w.dtype) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                g = g + wd.astype(w.dtype) * w
-                return w - lr.astype(w.dtype) * g, ()
-
-            self._run("sgd", fn, weight, grad._data, (),
-                      dict(lr=lr, wd=wd))
-            return None
-
-        def fn(w, g, m, lr, wd):
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            m = mom * m - lr.astype(w.dtype) * g
-            return w + m, (m,)
-
-        (new_m,) = self._run("sgd_mom", fn, weight, grad._data, (state,),
-                             dict(lr=lr, wd=wd))
-        return new_m
+        return super().update(index, weight, grad, state)
 
 
 @register
 class NAG(Optimizer):
     """Nesterov accelerated SGD (reference ``nag_mom_update``)."""
+
+    _has_fused_core = True
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
@@ -308,41 +397,21 @@ class NAG(Optimizer):
             return None
         return jnp.zeros(weight.shape, weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        rescale, clip, mom = self.rescale_grad, self.clip_gradient, \
-            self.momentum
-
-        if state is None:
-            def fn(w, g, lr, wd):
-                g = g.astype(w.dtype) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                g = g + wd.astype(w.dtype) * w
-                return w - lr.astype(w.dtype) * g, ()
-
-            self._run("nag0", fn, weight, grad._data, (),
-                      dict(lr=lr, wd=wd))
-            return None
-
-        def fn(w, g, m, lr, wd):
-            lr = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            m = mom * m + g
-            return w - lr * (g + mom * m), (m,)
-
-        (new_m,) = self._run("nag", fn, weight, grad._data, (state,),
-                             dict(lr=lr, wd=wd))
-        return new_m
+    def update_fn(self, w, g, states, lr, wd, t):
+        mom = self.momentum
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        if not states:
+            return w - lr.astype(w.dtype) * g, ()
+        (m,) = states
+        m = mom * m + g
+        return w - lr.astype(w.dtype) * (g + mom * m), (m,)
 
 
 @register
 class Adam(Optimizer):
     """Adam (reference ``adam_update``)."""
+
+    _has_fused_core = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
@@ -353,32 +422,25 @@ class Adam(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.zeros(weight.shape, weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+    def update_fn(self, w, g, states, lr, wd, t):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        m, v = state
-
-        def fn(w, g, m, v, lr, wd):
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * jnp.square(g)
-            w = w - lr.astype(w.dtype) * m / (jnp.sqrt(v) + eps)
-            return w, (m, v)
-
-        return self._run("adam", fn, weight, grad._data, (m, v),
-                         dict(lr=lr, wd=wd))
+        m, v = states
+        # in-graph bias correction from the traced step count: one
+        # executable serves every t (regression guard:
+        # test_adamw_bias_correction_not_frozen)
+        lr_t = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        w = w - lr_t.astype(w.dtype) * m / (jnp.sqrt(v) + eps)
+        return w, (m, v)
 
 
 @register
 class AdamW(Optimizer):
     """Decoupled weight decay Adam (reference contrib ``adamw_update``)."""
+
+    _has_fused_core = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
@@ -389,35 +451,24 @@ class AdamW(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.zeros(weight.shape, weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        correction = math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+    def update_fn(self, w, g, states, lr, wd, t):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        m, v = state
-
-        def fn(w, g, m, v, lr, wd, correction):
-            # correction is a traced scalar: baking it into the closure
-            # would freeze the t=1 bias correction into the jit cache
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * jnp.square(g)
-            w = w - lr_t * (correction.astype(w.dtype) * m
-                            / (jnp.sqrt(v) + eps)
-                            + wd.astype(w.dtype) * w)
-            return w, (m, v)
-
-        return self._run("adamw", fn, weight, grad._data, (m, v),
-                         dict(lr=lr, wd=wd, correction=correction))
+        m, v = states
+        correction = jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        w = w - lr_t * (correction.astype(w.dtype) * m
+                        / (jnp.sqrt(v) + eps)
+                        + wd.astype(w.dtype) * w)
+        return w, (m, v)
 
 
 @register
 class AdaGrad(Optimizer):
+    _has_fused_core = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -425,30 +476,22 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros(weight.shape, weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        eps, rescale, clip = self.float_stable_eps, self.rescale_grad, \
-            self.clip_gradient
-
-        def fn(w, g, h, lr, wd):
-            # reference AdaGrad: history accumulates the raw (rescaled,
-            # clipped) grad; wd applies at update time; eps inside the sqrt
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            h = h + jnp.square(g)
-            div = g / jnp.sqrt(h + eps)
-            w = w - lr.astype(w.dtype) * (div + wd.astype(w.dtype) * w)
-            return w, (h,)
-
-        (new_h,) = self._run("adagrad", fn, weight, grad._data, (state,),
-                             dict(lr=lr, wd=wd))
-        return new_h
+    def update_fn(self, w, g, states, lr, wd, t):
+        # reference AdaGrad: history accumulates the raw (rescaled,
+        # clipped) grad; wd applies at update time; eps inside the sqrt
+        eps = self.float_stable_eps
+        (h,) = states
+        g = self._clip_grad(w, g)
+        h = h + jnp.square(g)
+        div = g / jnp.sqrt(h + eps)
+        w = w - lr.astype(w.dtype) * (div + wd.astype(w.dtype) * w)
+        return w, (h,)
 
 
 @register
 class AdaDelta(Optimizer):
+    _has_fused_core = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
@@ -457,31 +500,22 @@ class AdaDelta(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.zeros(weight.shape, weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         rho, eps = self.rho, self.epsilon
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        acc_g, acc_d = state
-
-        def fn(w, g, ag, ad, lr, wd):
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            ag = rho * ag + (1 - rho) * jnp.square(g)
-            d = jnp.sqrt(ad + eps) / jnp.sqrt(ag + eps) * g
-            ad = rho * ad + (1 - rho) * jnp.square(d)
-            return w - d, (ag, ad)
-
-        return self._run("adadelta", fn, weight, grad._data, (acc_g, acc_d),
-                         dict(lr=0.0, wd=wd))
+        ag, ad = states
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        ag = rho * ag + (1 - rho) * jnp.square(g)
+        d = jnp.sqrt(ad + eps) / jnp.sqrt(ag + eps) * g
+        ad = rho * ad + (1 - rho) * jnp.square(d)
+        return w - d, (ag, ad)
 
 
 @register
 class RMSProp(Optimizer):
     """RMSProp, plain and centered (reference ``rmsprop_update`` /
     ``rmspropalex_update``)."""
+
+    _has_fused_core = True
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
@@ -497,53 +531,35 @@ class RMSProp(Optimizer):
                     jnp.zeros(weight.shape, weight.dtype))
         return (jnp.zeros(weight.shape, weight.dtype),)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        cw = self.clip_weights
+    def _unpack_state(self, states):
+        return states            # external state is the tuple itself
 
+    def update_fn(self, w, g, states, lr, wd, t):
+        g1, g2, eps, cw = self.gamma1, self.gamma2, self.epsilon, \
+            self.clip_weights
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
         if self.centered:
-            n, gbar, delta = state
-
-            def fn(w, g, n, gb, d, lr, wd):
-                lr_t = lr.astype(w.dtype)
-                g = g.astype(w.dtype) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                g = g + wd.astype(w.dtype) * w
-                n = g1 * n + (1 - g1) * jnp.square(g)
-                gb = g1 * gb + (1 - g1) * g
-                d = g2 * d - lr_t * g / jnp.sqrt(n - jnp.square(gb) + eps)
-                w = w + d
-                if cw is not None:
-                    w = jnp.clip(w, -cw, cw)
-                return w, (n, gb, d)
-
-            return self._run("rmsprop_c", fn, weight, grad._data,
-                             (n, gbar, delta), dict(lr=lr, wd=wd))
-
-        (n,) = state
-
-        def fn(w, g, n, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
+            n, gb, d = states
             n = g1 * n + (1 - g1) * jnp.square(g)
-            w = w - lr_t * g / jnp.sqrt(n + eps)
+            gb = g1 * gb + (1 - g1) * g
+            d = g2 * d - lr_t * g / jnp.sqrt(n - jnp.square(gb) + eps)
+            w = w + d
             if cw is not None:
                 w = jnp.clip(w, -cw, cw)
-            return w, (n,)
-
-        return self._run("rmsprop", fn, weight, grad._data, (n,),
-                         dict(lr=lr, wd=wd))
+            return w, (n, gb, d)
+        (n,) = states
+        n = g1 * n + (1 - g1) * jnp.square(g)
+        w = w - lr_t * g / jnp.sqrt(n + eps)
+        if cw is not None:
+            w = jnp.clip(w, -cw, cw)
+        return w, (n,)
 
 
 @register
 class Ftrl(Optimizer):
+    _has_fused_core = True
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1, self.beta = lamda1, beta
@@ -552,36 +568,28 @@ class Ftrl(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.zeros(weight.shape, weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         l1, beta = self.lamda1, self.beta
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        z, n = state
-
-        def fn(w, g, z, n, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr_t
-            z = z + g - sigma * w
-            n = n + jnp.square(g)
-            w = jnp.where(
-                jnp.abs(z) > l1,
-                -(z - jnp.sign(z) * l1)
-                / ((beta + jnp.sqrt(n)) / lr_t + wd.astype(w.dtype)),
-                0.0)
-            return w, (z, n)
-
-        return self._run("ftrl", fn, weight, grad._data, (z, n),
-                         dict(lr=lr, wd=wd))
+        z, n = states
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g)
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr_t
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        w = jnp.where(
+            jnp.abs(z) > l1,
+            -(z - jnp.sign(z) * l1)
+            / ((beta + jnp.sqrt(n)) / lr_t + wd.astype(w.dtype)),
+            0.0)
+        return w, (z, n)
 
 
 @register
 class LAMB(Optimizer):
     """Layer-wise adaptive large-batch optimizer (reference
     ``lamb_update_phase1/2``)."""
+
+    _has_fused_core = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
@@ -595,46 +603,36 @@ class LAMB(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.zeros(weight.shape, weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        bc = self.bias_correction
         lb, ub = self.lower_bound, self.upper_bound
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        m, v = state
-
-        def fn(w, g, m, v, lr, wd, t):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * jnp.square(g)
-            if bc:
-                mhat = m / (1 - jnp.power(b1, t).astype(w.dtype))
-                vhat = v / (1 - jnp.power(b2, t).astype(w.dtype))
-            else:
-                mhat, vhat = m, v
-            u = mhat / (jnp.sqrt(vhat) + eps) + wd.astype(w.dtype) * w
-            wnorm = jnp.linalg.norm(w.astype(jnp.float32))
-            unorm = jnp.linalg.norm(u.astype(jnp.float32))
-            if lb is not None:
-                wnorm = jnp.maximum(wnorm, lb)
-            if ub is not None:
-                wnorm = jnp.minimum(wnorm, ub)
-            ratio = jnp.where((wnorm > 0) & (unorm > 0),
-                              wnorm / unorm, 1.0).astype(w.dtype)
-            return w - lr_t * ratio * u, (m, v)
-
-        return self._run("lamb", fn, weight, grad._data, (m, v),
-                         dict(lr=lr, wd=wd, t=float(t)))
+        m, v = states
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        if self.bias_correction:
+            mhat = m / (1 - jnp.power(b1, t).astype(w.dtype))
+            vhat = v / (1 - jnp.power(b2, t).astype(w.dtype))
+        else:
+            mhat, vhat = m, v
+        u = mhat / (jnp.sqrt(vhat) + eps) + wd.astype(w.dtype) * w
+        wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+        unorm = jnp.linalg.norm(u.astype(jnp.float32))
+        if lb is not None:
+            wnorm = jnp.maximum(wnorm, lb)
+        if ub is not None:
+            wnorm = jnp.minimum(wnorm, ub)
+        ratio = jnp.where((wnorm > 0) & (unorm > 0),
+                          wnorm / unorm, 1.0).astype(w.dtype)
+        return w - lr_t * ratio * u, (m, v)
 
 
 @register
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference contrib LARS)."""
+
+    _has_fused_core = True
 
     def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9, **kwargs):
         super().__init__(**kwargs)
@@ -643,34 +641,26 @@ class LARS(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros(weight.shape, weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         mom, eta, eps = self.momentum, self.eta, self.epsilon
-        rescale, clip = self.rescale_grad, self.clip_gradient
-
-        def fn(w, g, m, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            wnorm = jnp.linalg.norm(w.astype(jnp.float32))
-            gnorm = jnp.linalg.norm(g.astype(jnp.float32))
-            trust = jnp.where(
-                (wnorm > 0) & (gnorm > 0),
-                eta * wnorm / (gnorm + wd * wnorm + eps), 1.0).astype(w.dtype)
-            g = g + wd.astype(w.dtype) * w
-            m = mom * m + trust * lr_t * g
-            return w - m, (m,)
-
-        (new_m,) = self._run("lars", fn, weight, grad._data, (state,),
-                             dict(lr=lr, wd=wd))
-        return new_m
+        (m,) = states
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g)
+        wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+        gnorm = jnp.linalg.norm(g.astype(jnp.float32))
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            eta * wnorm / (gnorm + wd * wnorm + eps), 1.0).astype(w.dtype)
+        g = g + wd.astype(w.dtype) * w
+        m = mom * m + trust * lr_t * g
+        return w - m, (m,)
 
 
 @register
 class Signum(Optimizer):
     """Sign-SGD with momentum (reference ``signum_update``)."""
+
+    _has_fused_core = True
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -681,80 +671,41 @@ class Signum(Optimizer):
             return None
         return jnp.zeros(weight.shape, weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         mom, wd_lh = self.momentum, self.wd_lh
-        rescale, clip = self.rescale_grad, self.clip_gradient
-
-        if state is None:
-            def fn(w, g, lr, wd):
-                g = g.astype(w.dtype) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                g = g + wd.astype(w.dtype) * w
-                return w - lr.astype(w.dtype) * jnp.sign(g), ()
-
-            self._run("signsgd", fn, weight, grad._data, (),
-                      dict(lr=lr, wd=wd))
-            return None
-
-        def fn(w, g, m, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            m = mom * m - (1 - mom) * g
-            w = w * (1 - lr_t * wd_lh) + lr_t * jnp.sign(m)
-            return w, (m,)
-
-        (new_m,) = self._run("signum", fn, weight, grad._data, (state,),
-                             dict(lr=lr, wd=wd))
-        return new_m
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        if not states:
+            return w - lr_t * jnp.sign(g), ()
+        (m,) = states
+        m = mom * m - (1 - mom) * g
+        w = w * (1 - lr_t * wd_lh) + lr_t * jnp.sign(m)
+        return w, (m,)
 
 
 @register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference SGLD)."""
 
+    _has_fused_core = True
+    _needs_rng = True
+
     def create_state(self, index, weight):
         return None
 
-    def update(self, index, weight, grad, state):
-        from .. import random as _random
-
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        key = _random.next_key()
-
-        def fn(w, g, key, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            noise = jax.random.normal(key, w.shape, w.dtype) \
-                * jnp.sqrt(lr).astype(w.dtype)
-            return w - 0.5 * lr_t * g + noise, ()
-
-        cache_key = ("sgld", weight.shape, str(weight.dtype),
-                     float(self.rescale_grad), self.clip_gradient)
-        jfn = self._jit_cache.get(cache_key)
-        if jfn is None:
-            jfn = jax.jit(fn)
-            self._jit_cache[cache_key] = jfn
-        new_w, _ = jfn(weight._data, grad._data, key,
-                       jnp.asarray(lr, jnp.float32),
-                       jnp.asarray(wd, jnp.float32))
-        weight._set_data(new_w)
-        return None
+    def update_fn(self, w, g, states, lr, wd, t, key=None):
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        noise = jax.random.normal(key, w.shape, w.dtype) \
+            * jnp.sqrt(lr).astype(w.dtype)
+        return w - 0.5 * lr_t * g + noise, ()
 
 
 @register
 class DCASGD(Optimizer):
     """Delay-compensated async SGD (reference DCASGD)."""
+
+    _has_fused_core = True
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
@@ -765,25 +716,14 @@ class DCASGD(Optimizer):
         return (jnp.zeros(weight.shape, weight.dtype),
                 jnp.array(weight._data, copy=True))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_fn(self, w, g, states, lr, wd, t):
         mom, lamda = self.momentum, self.lamda
-        rescale, clip = self.rescale_grad, self.clip_gradient
-        m, prev_w = state
-
-        def fn(w, g, m, pw, lr, wd):
-            lr_t = lr.astype(w.dtype)
-            g = g.astype(w.dtype) * rescale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            g = g + wd.astype(w.dtype) * w
-            g = g + lamda * g * g * (w - pw)
-            m = mom * m - lr_t * g
-            return w + m, (m, w)
-
-        return self._run("dcasgd", fn, weight, grad._data, (m, prev_w),
-                         dict(lr=lr, wd=wd))
+        m, pw = states
+        lr_t = lr.astype(w.dtype)
+        g = self._clip_grad(w, g) + wd.astype(w.dtype) * w
+        g = g + lamda * g * g * (w - pw)
+        m = mom * m - lr_t * g
+        return w + m, (m, w)
 
 
 class Updater:

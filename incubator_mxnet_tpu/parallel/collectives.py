@@ -83,6 +83,120 @@ def _process_mesh():
     return _proc_mesh
 
 
+def _stack_over_procs(arr, mesh, local_dev, nproc):
+    """Lift a process-local array into a global (nproc, *shape) array
+    sharded over the 'proc' axis — each process contributes its row."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    local = jax.device_put(jnp.asarray(arr)[None], local_dev)
+    return jax.make_array_from_single_device_arrays(
+        (nproc,) + tuple(arr.shape),
+        NamedSharding(mesh, PartitionSpec("proc")), [local])
+
+
+def replicate_across_processes(x: jax.Array) -> jax.Array:
+    """Wrap a per-process local copy of a replicated value as a global
+    replicated array on the process mesh (each process supplies its own
+    identical copy — no data movement). Single-process: identity. Used by
+    the FusedStep engine to feed weights/states into an executable whose
+    gradient allreduce runs on the same mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if jax.process_count() == 1:
+        return x
+    mesh = _process_mesh()
+    local = jax.device_put(jnp.asarray(x),
+                           mesh.devices.flat[jax.process_index()])
+    return jax.make_array_from_single_device_arrays(
+        tuple(x.shape), NamedSharding(mesh, PartitionSpec()), [local])
+
+
+def make_fused_allreduce(xs, compression: Optional[str] = None,
+                         compressor=None, keys=None):
+    """Payloads + a traceable reduction for fusing the cross-process
+    gradient allreduce INTO a larger jitted executable (the
+    ``gluon.trainer.FusedStep`` engine), instead of round-tripping through
+    ``allreduce_arrays`` before the optimizer runs.
+
+    Compression/packing happens host-side per process (2bit error-feedback
+    residuals live on the host ``compressor``, mirroring
+    ``allreduce_arrays``), while dequantize + sum lower into the SAME XLA
+    computation as the caller's, so XLA overlaps DCN traffic with the
+    update math.
+
+    Returns ``(payloads, reduce_fn)``: call ``reduce_fn(payloads)`` inside
+    the caller's jitted function to obtain the summed dense grads.
+    Single-process, payloads are the inputs themselves (2bit still
+    round-trips the compressor for numerics parity with the eager path)
+    and ``reduce_fn`` is the identity.
+    """
+    if jax.process_count() == 1:
+        if compression == "2bit":
+            from .compression import GradientCompression
+
+            gc = compressor or GradientCompression()
+            rkeys = keys if keys is not None else list(range(len(xs)))
+            payload = []
+            for k, x in zip(rkeys, xs):
+                x = jnp.asarray(x)
+                packed = gc.compress(k, x)
+                payload.append(gc.decompress(packed, x.shape, x.dtype))
+            return payload, lambda gs: gs
+        return list(xs), lambda gs: gs
+
+    mesh = _process_mesh()
+    nproc = jax.process_count()
+    local_dev = mesh.devices.flat[jax.process_index()]
+    shapes = [tuple(jnp.asarray(x).shape) for x in xs]
+    dtypes = [jnp.asarray(x).dtype for x in xs]
+
+    if compression == "2bit":
+        from .compression import GradientCompression
+
+        gc = compressor or GradientCompression()
+        th = gc.threshold
+        rkeys = keys if keys is not None else list(range(len(xs)))
+        payload = [_stack_over_procs(gc.compress(k, jnp.asarray(x)),
+                                     mesh, local_dev, nproc)
+                   for k, x in zip(rkeys, xs)]
+
+        def reduce_2bit(packs):
+            from .compression import dequantize_2bit
+
+            out = []
+            for p, shp, dt in zip(packs, shapes, dtypes):
+                deq = jax.vmap(lambda row: dequantize_2bit(row, shp, th))(p)
+                out.append(jnp.sum(deq, axis=0).astype(dt))
+            return out
+
+        return payload, reduce_2bit
+
+    if compression == "int8":
+        payload = []
+        for x in xs:
+            x = jnp.asarray(x)
+            scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-20) / 127.0
+            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+            payload.append(
+                (_stack_over_procs(q, mesh, local_dev, nproc),
+                 _stack_over_procs(scale.reshape(1).astype(jnp.float32),
+                                   mesh, local_dev, nproc)))
+
+        def reduce_int8(pairs):
+            out = []
+            for (q, s), dt in zip(pairs, dtypes):
+                deq = q.astype(jnp.float32) * s.reshape(
+                    (nproc,) + (1,) * (q.ndim - 1))
+                out.append(jnp.sum(deq, axis=0).astype(dt))
+            return out
+
+        return payload, reduce_int8
+
+    payload = [_stack_over_procs(jnp.asarray(x), mesh, local_dev, nproc)
+               for x in xs]
+    return payload, lambda gs: [jnp.sum(g, axis=0) for g in gs]
+
+
 def allreduce_arrays(xs, compression: Optional[str] = None,
                      compressor=None, keys=None):
     """Sum a LIST of identically-shaped-per-process arrays across all
@@ -102,116 +216,31 @@ def allreduce_arrays(xs, compression: Optional[str] = None,
     ``compressor`` (a ``compression.GradientCompression``). ``keys``
     (parallel to ``xs``) names each tensor's residual slot; the
     enumerate-index fallback is only safe when every call passes the same
-    tensors in the same order."""
+    tensors in the same order.
+
+    Built ON ``make_fused_allreduce`` — one source of truth for the
+    payload wire format; this is the standalone (own-executable) flavor,
+    the FusedStep engine traces the same ``reduce_fn`` into its fused
+    step instead."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    payload, reduce_fn = make_fused_allreduce(
+        xs, compression=compression, compressor=compressor, keys=keys)
     if jax.process_count() == 1:
-        if compression == "2bit":
-            # keep error-feedback semantics observable single-process:
-            # round-trip through the compressor exactly like the
-            # multi-process path (tests + numerics parity)
-            from .compression import GradientCompression
-
-            gc = compressor or GradientCompression()
-            rkeys = keys if keys is not None else list(range(len(xs)))
-            outs = []
-            for k, x in zip(rkeys, xs):
-                x = jnp.asarray(x)
-                packed = gc.compress(k, x)
-                outs.append(gc.decompress(packed, x.shape, x.dtype))
-            return outs
-        return list(xs)
+        # reduce_fn is the identity (2bit already round-tripped the
+        # compressor for error-feedback parity)
+        return payload
     mesh = _process_mesh()
-    nproc = jax.process_count()
-    rank = jax.process_index()
-    local_dev = mesh.devices.flat[rank]
-    shard_sharding = NamedSharding(mesh, PartitionSpec("proc"))
-
-    def _to_global(arr):
-        local = jax.device_put(jnp.asarray(arr)[None], local_dev)
-        return jax.make_array_from_single_device_arrays(
-            (nproc,) + tuple(arr.shape), shard_sharding, [local])
-
-    if compression == "2bit":
-        from .compression import GradientCompression
-
-        gc = compressor or GradientCompression()
-        th = gc.threshold
-        rkeys = keys if keys is not None else list(range(len(xs)))
-        payload = []
-        for k, x in zip(rkeys, xs):
-            x = jnp.asarray(x)
-            payload.append(_to_global(gc.compress(k, x)))
-        key = ("2bit", th) + tuple(
-            (tuple(jnp.asarray(x).shape), str(jnp.asarray(x).dtype))
-            for x in xs)
-        fn = _allreduce_cache.get(key)
-        if fn is None:
-            replicated = NamedSharding(mesh, PartitionSpec())
-            shapes = [tuple(jnp.asarray(x).shape) for x in xs]
-
-            def _sum_dequant_2bit(packs):
-                from .compression import dequantize_2bit
-
-                out = []
-                for p, shp in zip(packs, shapes):
-                    # p: (nproc, packed_len) uint8 — unpack + dequantize
-                    # each process's codes, sum over the proc axis
-                    deq = jax.vmap(
-                        lambda row: dequantize_2bit(row, shp, th))(p)
-                    out.append(jnp.sum(deq, axis=0))
-                return out
-
-            fn = jax.jit(_sum_dequant_2bit,
-                         out_shardings=[replicated for _ in xs])
-            _allreduce_cache[key] = fn
-        outs = fn(payload)
-        return [o.addressable_data(0).astype(jnp.asarray(x).dtype)
-                for o, x in zip(outs, xs)]
-
-    if compression == "int8":
-        payload = []
-        for x in xs:
-            x = jnp.asarray(x)
-            scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-20) / 127.0
-            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-            payload.append((_to_global(q),
-                            _to_global(scale.reshape(1).astype(
-                                jnp.float32))))
-        key = ("int8",) + tuple(
-            (tuple(x.shape), str(x.dtype)) for x in xs)
-        fn = _allreduce_cache.get(key)
-        if fn is None:
-            replicated = NamedSharding(mesh, PartitionSpec())
-
-            def _sum_dequant(pairs):
-                out = []
-                for q, s in pairs:
-                    # dequant per contributing process, sum over processes
-                    deq = q.astype(jnp.float32) * s.reshape(
-                        (nproc,) + (1,) * (q.ndim - 1))
-                    out.append(jnp.sum(deq, axis=0))
-                return out
-
-            fn = jax.jit(_sum_dequant,
-                         out_shardings=[replicated for _ in xs])
-            _allreduce_cache[key] = fn
-        outs = fn(payload)
-        return [o.addressable_data(0).astype(x.dtype)
-                for o, x in zip(outs, xs)]
-
-    gxs = [_to_global(x) for x in xs]
-    key = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-    fn = _allreduce_cache.get(key)
+    cache_key = (compression,
+                 getattr(compressor, "threshold", None)
+                 if compression == "2bit" else None) + tuple(
+        (tuple(jnp.asarray(x).shape), str(jnp.asarray(x).dtype))
+        for x in xs)
+    fn = _allreduce_cache.get(cache_key)
     if fn is None:
         replicated = NamedSharding(mesh, PartitionSpec())
-
-        def _sum_all(arrs):
-            return [jnp.sum(a, axis=0) for a in arrs]
-
-        fn = jax.jit(_sum_all,
-                     out_shardings=[replicated for _ in xs])
-        _allreduce_cache[key] = fn
-    outs = fn(gxs)
+        fn = jax.jit(reduce_fn, out_shardings=[replicated for _ in xs])
+        _allreduce_cache[cache_key] = fn
+    outs = fn(payload)
     # each output is replicated on the process mesh; hand back the local copy
     return [o.addressable_data(0) for o in outs]

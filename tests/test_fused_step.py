@@ -1,0 +1,335 @@
+"""FusedStep engine tests: fused-vs-eager parity, donation semantics,
+hyperparameter-mutation recompiles, and the O(1)-dispatch regression guard
+that keeps the per-parameter update loop from silently coming back."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu import optimizer as opt_mod
+from incubator_mxnet_tpu.gluon import Parameter
+
+
+def _make_params(n, seed=0, shape=(4, 3)):
+    """n initialized Parameters with attached (fresh) synthetic grads."""
+    rng = np.random.RandomState(seed)
+    params = []
+    for k in range(n):
+        p = Parameter(name=f"p{k}", shape=shape)
+        p.initialize(init="zeros")
+        p.set_data(mx.nd.array(rng.rand(*shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, seed):
+    rng = np.random.RandomState(seed)
+    for p in params:
+        g = p._data._grad
+        g._data = mx.nd.array(rng.rand(*p.shape).astype(np.float32))._data
+        p._data._grad_fresh = True
+
+
+def _weights(params):
+    return [p.data().asnumpy() for p in params]
+
+
+def _run_steps(trainer, params, steps, batch=8, seed0=100):
+    for s in range(steps):
+        _set_grads(params, seed0 + s)
+        trainer.step(batch)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+])
+def test_fused_matches_eager(name, kwargs):
+    """Weights AND optimizer states allclose after N steps, fused vs the
+    per-parameter path over the same functional core."""
+    import jax
+
+    n_steps = 5
+    pf = _make_params(6, seed=1)
+    pe = _make_params(6, seed=1)
+    tf = gluon.Trainer(pf, name, dict(kwargs))
+    te = gluon.Trainer(pe, name, dict(kwargs)).fused_step(False)
+    _run_steps(tf, pf, n_steps)
+    _run_steps(te, pe, n_steps)
+    assert tf._fused.dispatch_count == n_steps
+    assert te._fused.dispatch_count == 0
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+    for i in tf._updater.states:
+        sf = jax.tree_util.tree_leaves(tf._updater.states[i])
+        se = jax.tree_util.tree_leaves(te._updater.states[i])
+        assert len(sf) == len(se)
+        for a, b in zip(sf, se):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_grad_buffers_readable_after_donation():
+    """Weights/states are donated into the executable; grads are NOT —
+    the grad buffer must be readable (and unchanged) after step()."""
+    params = _make_params(3, seed=2)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    _set_grads(params, 7)
+    before = [p.grad().asnumpy().copy() for p in params]
+    trainer.step(4)
+    assert trainer._fused.dispatch_count == 1
+    after = [p.grad().asnumpy() for p in params]
+    for b, a in zip(before, after):
+        np.testing.assert_allclose(a, b)
+
+
+def test_hyperparameter_mutation_recompiles():
+    """Mutating a closure-captured hyperparameter (momentum warm-up)
+    mid-training must produce a NEW executable, not silently reuse the
+    stale constant — and numerics must track the eager path through the
+    same mutation."""
+    pf = _make_params(4, seed=3)
+    pe = _make_params(4, seed=3)
+    tf = gluon.Trainer(pf, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    te = gluon.Trainer(pe, "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9}).fused_step(False)
+    _run_steps(tf, pf, 2)
+    _run_steps(te, pe, 2)
+    assert len(tf._fused._cache) == 1
+    tf._optimizer.momentum = 0.5
+    te._optimizer.momentum = 0.5
+    _run_steps(tf, pf, 2, seed0=200)
+    _run_steps(te, pe, 2, seed0=200)
+    assert len(tf._fused._cache) == 2, "momentum mutation must recompile"
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+
+
+def test_rescale_change_does_not_recompile():
+    """rescale_grad is a per-step traced scalar (Trainer.step rewrites it
+    every step; amp loss scaling and partial final batches change it):
+    varying batch_size must reuse the SAME executable, with numerics
+    matching the eager path."""
+    pf = _make_params(3, seed=20)
+    pe = _make_params(3, seed=20)
+    tf = gluon.Trainer(pf, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    te = gluon.Trainer(pe, "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9}).fused_step(False)
+    for s, batch in enumerate([8, 32, 5, 8]):      # incl. a "partial" batch
+        _set_grads(pf, 400 + s)
+        tf.step(batch)
+        _set_grads(pe, 400 + s)
+        te.step(batch)
+    assert len(tf._fused._cache) == 1, \
+        "batch-size (rescale) change must not recompile the fused step"
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+
+
+def test_dispatch_count_is_o1_in_param_count():
+    """Regression guard: one fused Trainer.step over a >=50-parameter
+    model must issue O(1) XLA executions — the per-parameter loop (one
+    Optimizer._run per parameter) can never silently come back."""
+    n_params, n_steps = 60, 3
+    params = _make_params(n_params, seed=4, shape=(8,))
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+
+    per_param_runs = {"n": 0}
+    orig_run = opt_mod.Optimizer._run
+
+    def counting_run(self, *a, **kw):
+        per_param_runs["n"] += 1
+        return orig_run(self, *a, **kw)
+
+    opt_mod.Optimizer._run = counting_run
+    try:
+        _run_steps(trainer, params, n_steps)
+    finally:
+        opt_mod.Optimizer._run = orig_run
+    # one executable invocation per step, independent of parameter count
+    assert trainer._fused.dispatch_count == n_steps
+    assert per_param_runs["n"] == 0, \
+        "fused step must not fall back to per-parameter dispatches"
+    assert len(trainer._fused._cache) == 1
+
+
+def test_sparse_grad_falls_back_to_eager():
+    params = _make_params(2, seed=5)
+    # make one param's grad row-sparse
+    params[1].grad_req = "null"
+    params[1]._grad_stype = "row_sparse"
+    params[1].grad_req = "write"
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    _set_grads([params[0]], 9)
+    g = params[1]._data._grad
+    assert isinstance(g, RowSparseNDArray)
+    g._rdata = mx.nd.array(np.ones((1, 3), np.float32))._data
+    g._indices = mx.nd.array(np.array([2]))._data.astype("int32")
+    params[1]._data._grad_fresh = True
+    w1_before = params[1].data().asnumpy().copy()
+    trainer.step(2)
+    assert trainer._fused.dispatch_count == 0
+    assert trainer._fused.last_fallback == "row-sparse gradient"
+    # the eager path still applied the sparse update to the touched row
+    w1 = params[1].data().asnumpy()
+    assert not np.allclose(w1[2], w1_before[2])
+    np.testing.assert_allclose(w1[0], w1_before[0])
+
+
+def test_update_on_kvstore_falls_back_and_batches():
+    params = _make_params(3, seed=6)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            update_on_kvstore=True)
+    calls = {"n": 0}
+    _run_steps(trainer, params, 1)
+    assert trainer._fused.dispatch_count == 0
+    assert trainer._fused.last_fallback == "update_on_kvstore"
+    # satellite: per-parameter push/pull pairs became ONE pushpull_list
+    orig = trainer._kvstore.pushpull_list
+
+    def counting(keys, values, outs, priority=0):
+        calls["n"] += 1
+        assert len(keys) == 3
+        return orig(keys, values, outs, priority)
+
+    trainer._kvstore.pushpull_list = counting
+    _set_grads(params, 11)
+    trainer.step(8)
+    assert calls["n"] == 1
+    # and the kvstore-updated weights match a local eager trainer
+    pe = _make_params(3, seed=6)
+    te = gluon.Trainer(pe, "sgd", {"learning_rate": 0.1}).fused_step(False)
+    _run_steps(te, pe, 1)
+    _set_grads(pe, 11)
+    te.step(8)
+    for wf, we in zip(_weights(params), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6)
+
+
+def test_states_roundtrip_fused_to_eager(tmp_path):
+    """save_states from a fused run loads into an eager run (and vice
+    versa): both paths traffic in the same external state structures."""
+    pf = _make_params(3, seed=7)
+    tf = gluon.Trainer(pf, "adam", {"learning_rate": 0.05})
+    _run_steps(tf, pf, 3)
+    f = str(tmp_path / "states")
+    tf.save_states(f)
+
+    pe = _make_params(3, seed=7)
+    te = gluon.Trainer(pe, "adam", {"learning_rate": 0.05}).fused_step(False)
+    _run_steps(te, pe, 3)
+    te.load_states(f)
+    # continue both; trajectories must agree
+    for p, q in zip(pf, pe):
+        q.set_data(p.data())
+    _run_steps(tf, pf, 2, seed0=300)
+    _run_steps(te, pe, 2, seed0=300)
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-5, atol=1e-6)
+
+
+def test_shard_update_flag_single_process_parity():
+    """fused_step(shard_update=True) (ZeRO-1) degenerates to the normal
+    fused step on one process — same numbers, still O(1) dispatches."""
+    pf = _make_params(5, seed=8)
+    pe = _make_params(5, seed=8)
+    tf = gluon.Trainer(pf, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    tf.fused_step(True, shard_update=True)
+    te = gluon.Trainer(pe, "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9}).fused_step(False)
+    _run_steps(tf, pf, 4)
+    _run_steps(te, pe, 4)
+    assert tf._fused.dispatch_count == 4
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+
+
+def test_lr_scheduler_parity_and_bookkeeping():
+    from incubator_mxnet_tpu.lr_scheduler import FactorScheduler
+
+    pf = _make_params(3, seed=9)
+    pe = _make_params(3, seed=9)
+    tf = gluon.Trainer(pf, "sgd", {
+        "learning_rate": 1.0,
+        "lr_scheduler": FactorScheduler(step=2, factor=0.5, base_lr=1.0)})
+    te = gluon.Trainer(pe, "sgd", {
+        "learning_rate": 1.0,
+        "lr_scheduler": FactorScheduler(step=2, factor=0.5, base_lr=1.0)})
+    te.fused_step(False)
+    _run_steps(tf, pf, 5)
+    _run_steps(te, pe, 5)
+    assert tf.learning_rate == te.learning_rate == 0.25
+    assert tf._optimizer.num_update == te._optimizer.num_update == 5
+    for wf, we in zip(_weights(pf), _weights(pe)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+
+
+def test_stale_grad_raises_and_ignore_skips():
+    params = _make_params(2, seed=10)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    _set_grads(params, 12)
+    trainer.step(2)
+    # grads now stale: strict step raises, ignore_stale_grad skips
+    with pytest.raises(UserWarning):
+        trainer.step(2)
+    w = _weights(params)
+    trainer.step(2, ignore_stale_grad=True)
+    for a, b in zip(w, _weights(params)):
+        np.testing.assert_allclose(a, b)
+
+
+def test_make_fused_allreduce_single_process():
+    """The in-graph allreduce building block: identity single-process, and
+    the 2bit path round-trips the compressor (error-feedback parity with
+    the eager kvstore path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel.collectives import (
+        allreduce_arrays, make_fused_allreduce)
+    from incubator_mxnet_tpu.parallel.compression import GradientCompression
+
+    xs = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+          jnp.ones((4,), jnp.float32)]
+    payload, reduce_fn = make_fused_allreduce(xs)
+    outs = jax.jit(lambda gs: reduce_fn(gs))(tuple(payload))
+    for o, x in zip(outs, xs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(x))
+
+    gc_f = GradientCompression(threshold=0.5)
+    gc_e = GradientCompression(threshold=0.5)
+    payload, reduce_fn = make_fused_allreduce(
+        xs, compression="2bit", compressor=gc_f, keys=["a", "b"])
+    fused_outs = reduce_fn(payload)
+    eager_outs = allreduce_arrays(xs, compression="2bit", compressor=gc_e,
+                                  keys=["a", "b"])
+    for f, e in zip(fused_outs, eager_outs):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(e))
+
+
+def test_fused_mlp_end_to_end_training():
+    """Real autograd-driven training through the fused path converges, and
+    every step is one executable."""
+    from incubator_mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    w_true = np.random.rand(4, 1).astype(np.float32)
+    x_np = np.random.rand(64, 4).astype(np.float32)
+    y_np = x_np @ w_true
+    net = nn.Dense(1, use_bias=False, in_units=4)
+    net.initialize(init="zeros")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.L2Loss()
+    x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+    for _ in range(200):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(64)
+    assert trainer._fused.dispatch_count == 200
+    np.testing.assert_allclose(net.weight.data().asnumpy().ravel(),
+                               w_true.ravel(), atol=1e-2)
