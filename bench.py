@@ -107,19 +107,67 @@ def _run_steps_fit(trainer, x, y):
     return _fit_windows(window)
 
 
-def _fit_windows(window, n1=None, n2=None):
-    """Slope of t(n) between two window sizes (default ITERS/ITERS2) —
-    cancels the fixed fence term; falls back to the long-window mean if
-    variance flips the fit. THE one implementation of the round-5
-    fence-cancelling methodology — benchmark/ scripts import it."""
-    n1 = ITERS if n1 is None else n1
-    n2 = ITERS2 if n2 is None else n2
+# Round-6 reproducibility fix (VERDICT r5 blocker #1): ONE two-point fit
+# is a single (t2-t1)/20 slope — a +-20-30% tunnel transient in EITHER
+# window skews it by 1.5-2x, which is exactly the size of the BENCH_r05
+# vs PROFILE.md disagreements (BERT 69.7% vs 43.3% MFU, MLP 2x). Every
+# fit now runs K independent repeats; the RECORDED number is the median
+# and the spread is emitted next to it so a noisy run is visible in the
+# artifact instead of silently becoming the round's headline.
+
+
+def _fit_k():
+    """MXTPU_BENCH_FIT_K via the typed registry (docs/ENV_VARS.md),
+    resolved lazily — the driver loop never imports the package/jax."""
+    from incubator_mxnet_tpu.config import config
+
+    return int(config.get("MXTPU_BENCH_FIT_K"))
+
+#: per-config fit diagnostics of the LAST _fit_windows call (each config
+#: runs in its own subprocess, so this is exactly that config's fit);
+#: run_one attaches it to the emitted JSON line
+LAST_FIT_STATS = None
+
+
+def _fit_once(window, n1, n2):
     t1 = window(n1)
     t2 = window(n2)
     per = (t2 - t1) / (n2 - n1)
     if per <= 0:          # tunnel variance swamped the fit
         per = t2 / n2
     return per
+
+
+def _fit_windows(window, n1=None, n2=None, k=None):
+    """Median of ``k`` (default MXTPU_BENCH_FIT_K >= 3) independent
+    two-point fits of
+    t(n) between two window sizes (default ITERS/ITERS2). Each fit's
+    slope cancels the fixed ~60-100 ms PJRT-tunnel fence term (round-5
+    methodology); the median-of-k with recorded spread (LAST_FIT_STATS /
+    the ``fit`` JSON field) is the round-6 reproducibility layer. THE one
+    implementation of the fence-cancelling methodology — benchmark/
+    scripts import it.
+
+    Canonical MFU accounting (the one documented formula):
+        mfu_pct = 100 * (step_flops / median_per_step) / CEILING_TFS
+    with step_flops from XLA's own cost analysis and median_per_step from
+    THIS function. BENCH json lines and the PROFILE.md tables must both
+    cite it."""
+    global LAST_FIT_STATS
+    n1 = ITERS if n1 is None else n1
+    n2 = ITERS2 if n2 is None else n2
+    k = _fit_k() if k is None else k
+    fits = sorted(_fit_once(window, n1, n2) for _ in range(max(1, k)))
+    med = fits[len(fits) // 2] if len(fits) % 2 \
+        else 0.5 * (fits[len(fits) // 2 - 1] + fits[len(fits) // 2])
+    LAST_FIT_STATS = {
+        "k": len(fits),
+        "per_ms": [round(f * 1e3, 4) for f in fits],
+        "median_ms": round(med * 1e3, 4),
+        "spread_pct": round(100.0 * (fits[-1] - fits[0]) / med, 1)
+        if med > 0 else None,
+    }
+    return med
 
 
 # measured MXU ceiling: 187.9 TF/s via fence-free two-point-fit timing
@@ -361,6 +409,8 @@ def run_one(key):
         if tfs:
             line["tfs"] = round(tfs, 2)
             line["mfu_pct"] = round(100.0 * tfs / CEILING_TFS, 1)
+        if LAST_FIT_STATS is not None:
+            line["fit"] = LAST_FIT_STATS
         print(json.dumps(line), flush=True)
         return 0
     except Exception as e:

@@ -1,18 +1,24 @@
 #!/usr/bin/env python
 """Fused Pallas conv+BN kernel vs the XLA conv->BN chain, per ResNet-50
-conv shape, on the real chip.
+conv shape, on the real chip — forward AND backward rows.
 
-Two measurements per shape (forward semantics, training BN):
+Three measurements per shape (training BN semantics):
+  conv  — lax.conv alone (the per-shape roofline reference)
   xla   — lax.conv (bf16, fp32 acc) -> per-channel mean/var stat pass ->
-          normalize+relu apply pass (what the model does today)
+          normalize+relu apply pass (what the zoo model does today)
   fused — Pallas fused_conv_bn (prologue BN+relu of the PREVIOUS layer +
           conv + stats epilogue) — one HBM round-trip
+plus, with ``--bwd``, the gradient of a scalarized head through each
+formulation (the v2 Pallas dx/dW kernels vs XLA's transpose-conv
+autodiff; ``MXTPU_CONV_BWD`` governs the fused dispatch).
 
-Timing: on-device lax.fori_loop over ITERS applications with a carried
-dependency, one device_get sync (per-step sync through the axon tunnel
-costs ~100 ms — see PROFILE.md).
+Timing: fence-cancelling repeated two-point fits over on-device
+lax.fori_loop windows (bench._fit_windows — median of K fits with
+recorded spread; a per-step sync through the axon tunnel costs ~100 ms,
+see PROFILE.md).
 
 Usage: python benchmark/fused_conv_bench.py [--iters 20] [--batch 64]
+           [--bwd] [--shapes l2.3x3,l4.3x3]
 """
 
 from __future__ import annotations
@@ -20,7 +26,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -34,6 +39,7 @@ SHAPES = [
     ("l2.3x3", 28, 128, 128, 3, 1),
     ("l2.1x1b", 28, 128, 512, 1, 1),
     ("l2.down", 56, 256, 512, 1, 2),
+    ("l2.3x3s", 56, 128, 128, 3, 2),
     ("l3.3x3", 14, 256, 256, 3, 1),
     ("l3.1x1b", 14, 256, 1024, 1, 1),
     ("l4.3x3", 7, 512, 512, 3, 1),
@@ -46,12 +52,15 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--shapes", type=str, default="")
+    ap.add_argument("--bwd", action="store_true",
+                    help="also measure the backward of each formulation")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    from benchmark.resnet_decision_bench import fit_time
     from incubator_mxnet_tpu.ops.pallas_conv import fused_conv_bn
 
     n = args.batch
@@ -59,30 +68,15 @@ def main():
     rs = np.random.RandomState(0)
     only = set(args.shapes.split(",")) if args.shapes else None
 
-    def xla_chain(x, w, g, b):
-        dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                        ("NHWC", "HWIO", "NHWC"))
-        k, s = w.shape[0], stride
-        y = lax.conv_general_dilated(
-            x, w, (s, s), [(k // 2, k // 2)] * 2, dimension_numbers=dn,
-            preferred_element_type=jnp.float32)
-        mu = jnp.mean(y, axis=(0, 1, 2))
-        var = jnp.maximum(jnp.mean(y * y, axis=(0, 1, 2)) - mu * mu, 0.0)
-        out = ((y - mu) * lax.rsqrt(var + 1e-5) * g + b)
-        return jnp.maximum(out, 0.0).astype(x.dtype)
-
-    def fused(x, w, a, b):
-        k = w.shape[0]
-        y, s_, ss = fused_conv_bn(x, w, a, b, stride=stride, pad=k // 2,
-                                  relu=True)
-        return y, s_, ss
-
-    print(f"batch={n} iters={iters} dev={jax.devices()[0].device_kind}")
-    print(f"{'shape':10s} {'conv ms':>8s} {'xla ms':>8s} {'fused ms':>9s} "
-          f"{'speedup':>8s} {'TF/s cv':>9s} {'TF/s fus':>9s}")
+    print(f"batch={n} iters={iters}/{4 * iters} (fit windows) "
+          f"dev={jax.devices()[0].device_kind}")
+    hdr = f"{'shape':10s} {'dir':3s} {'conv ms':>8s} {'xla ms':>8s} " \
+          f"{'fused ms':>9s} {'speedup':>8s} {'TF/s fus':>9s}"
+    print(hdr)
     for name, h, ci, co, k, stride in SHAPES:
         if only and name not in only:
             continue
+        pad = k // 2
         x = jnp.asarray(rs.randn(n, h, h, ci), jnp.bfloat16)
         w = jnp.asarray(rs.randn(k, k, ci, co) * 0.05, jnp.bfloat16)
         g = jnp.ones((co,), jnp.float32)
@@ -92,54 +86,99 @@ def main():
         ho = h // stride
         flops = 2 * n * ho * ho * ci * co * k * k
 
-        # serialize iterations through the (small) WEIGHT operand — a
-        # whole-x dependency multiply costs an extra HBM pass that
-        # pollutes the measurement; device_get moves ONE float (a full-
-        # tensor fetch through the axon tunnel costs seconds)
-        def _loop(step):
-            def run(x):
-                def body(_, wc):
-                    out = step(x, wc)
-                    # direct scalar index: reshape(-1)[0] forces a full
-                    # relayout pass and was masking the conv time
-                    dep = out[(0,) * out.ndim].astype(jnp.float32)
-                    return wc * (1.0 + 0.0 * dep).astype(wc.dtype)
-                return jnp.sum(lax.fori_loop(0, iters, body, w)[0, 0]
-                               ).astype(jnp.float32)
-            return run
-
-        def conv_only(x, wc):
-            dn = lax.conv_dimension_numbers(x.shape, wc.shape,
+        def conv_only(c, wc):
+            dn = lax.conv_dimension_numbers(c.shape, wc.shape,
                                             ("NHWC", "HWIO", "NHWC"))
-            kk = wc.shape[0]
-            return lax.conv_general_dilated(
-                x, wc, (stride, stride), [(kk // 2, kk // 2)] * 2,
+            # bf16 runs natively (f32 preferred_element_type would mix
+            # dtypes in the conv transpose — same constraint as
+            # _conv_part_ref; the MXU still accumulates fp32 internally)
+            low = c.dtype in (jnp.bfloat16, jnp.float16)
+            y = lax.conv_general_dilated(
+                c, wc, (stride, stride), [(pad, pad)] * 2,
                 dimension_numbers=dn,
-                preferred_element_type=jnp.float32).astype(x.dtype)
+                preferred_element_type=None if low else jnp.float32)
+            return y.astype(c.dtype), None, None
 
-        loop_conv = _loop(conv_only)
-        loop_xla = _loop(lambda x, wc: xla_chain(x, wc, g, b))
-        loop_fused = _loop(lambda x, wc: fused(x, wc, a_pro, b_pro)[0])
+        def xla_chain(c, wc):
+            y, _, _ = conv_only(c, wc)
+            y32 = y.astype(jnp.float32)
+            mu = jnp.mean(y32, axis=(0, 1, 2))
+            var = jnp.maximum(jnp.mean(y32 * y32, axis=(0, 1, 2))
+                              - mu * mu, 0.0)
+            out = ((y32 - mu) * lax.rsqrt(var + 1e-5) * g + b)
+            return jnp.maximum(out, 0.0).astype(c.dtype), mu, var
 
-        res = {}
-        for label, fn in (("conv", loop_conv), ("xla", loop_xla),
-                          ("fused", loop_fused)):
-            try:
-                jf = jax.jit(fn)
-                float(jax.device_get(jf(x)))  # compile+warm
-                t0 = time.perf_counter()
-                float(jax.device_get(jf(x)))
-                dt = (time.perf_counter() - t0) / iters
-                res[label] = dt
-            except Exception as e:
-                print(f"{name:10s} {label} FAILED: {str(e)[:120]}")
-                res[label] = float("nan")
-        if all(np.isfinite(v) for v in res.values()):
-            print(f"{name:10s} {res['conv']*1e3:8.3f} {res['xla']*1e3:8.3f} "
-                  f"{res['fused']*1e3:9.3f} "
-                  f"{res['xla']/res['fused']:8.2f} "
-                  f"{flops/res['conv']/1e12:9.1f} "
-                  f"{flops/res['fused']/1e12:9.1f}", flush=True)
+        def fused(c, wc):
+            return fused_conv_bn(c, wc, a_pro, b_pro, stride=stride,
+                                 pad=pad, relu=True)
+
+        def fwd_loop(step):
+            # serialize iterations through the (small) WEIGHT operand —
+            # a whole-x carried dependency costs an extra HBM pass over
+            # the activation that pollutes the measurement; x rides in as
+            # an argument (a captured constant would be const-folded);
+            # the dep is a direct scalar index (reshape(-1)[0] forces a
+            # relayout)
+            def body_of(xx):
+                def body(i, wc):
+                    out, s1, s2 = step(xx, wc)
+                    dep = out[(0,) * out.ndim].astype(jnp.float32)
+                    if s1 is not None:
+                        dep = dep + (s1[0] + s2[0]) * 1e-20
+                    return wc * (1.0 + 0.0 * dep).astype(wc.dtype)
+                return body
+            return jax.jit(lambda kk, xx: jnp.sum(
+                lax.fori_loop(0, kk, body_of(xx), w)[(0,) * w.ndim]
+                .astype(jnp.float32)), static_argnums=0)
+
+        def bwd_loop(step):
+            def loss(c, wc):
+                out, s1, s2 = step(c, wc)
+                head = jnp.sum(out.astype(jnp.float32)) * 1e-6
+                if s1 is not None:
+                    head = head + jnp.sum(s1) * 1e-8 + jnp.sum(s2) * 1e-10
+                return head
+
+            grad = jax.grad(loss, argnums=(0, 1))
+
+            def body_of(xx):
+                def body(i, wc):
+                    dx, dw = grad(xx, wc)
+                    # scalar deps keep BOTH grad instructions live (XLA
+                    # DCEs whole instructions, not elements) without an
+                    # extra HBM pass over the activation-sized dx
+                    dep = (dx[(0,) * dx.ndim].astype(jnp.float32)
+                           + dw[(0,) * dw.ndim].astype(jnp.float32))
+                    return wc * (1.0 + 0.0 * dep).astype(wc.dtype)
+                return body
+            return jax.jit(lambda kk, xx: jnp.sum(
+                lax.fori_loop(0, kk, body_of(xx), w)[(0,) * w.ndim]
+                .astype(jnp.float32)), static_argnums=0)
+
+        rows = [("fwd", fwd_loop, flops)]
+        if args.bwd:
+            # the grad row executes fwd + dx + dW (forward recompute is
+            # not DCE-able: the stats cotangent needs y) ~ 3x fwd FLOPs
+            rows.append(("f+b", bwd_loop, 3 * flops))
+        for tag, mk, fl in rows:
+            res = {}
+            for label, step in (("conv", conv_only), ("xla", xla_chain),
+                                ("fused", fused)):
+                try:
+                    run = mk(step)
+                    per, _ = fit_time(
+                        lambda kk: jax.device_get(run(kk, x)), iters,
+                        4 * iters)
+                    res[label] = per
+                except Exception as e:
+                    print(f"{name:10s} {tag} {label} FAILED: "
+                          f"{str(e)[:110]}")
+                    res[label] = float("nan")
+            if all(np.isfinite(v) for v in res.values()):
+                print(f"{name:10s} {tag:3s} {res['conv']*1e3:8.3f} "
+                      f"{res['xla']*1e3:8.3f} {res['fused']*1e3:9.3f} "
+                      f"{res['xla']/res['fused']:8.2f} "
+                      f"{fl/res['fused']/1e12:9.1f}", flush=True)
 
 
 if __name__ == "__main__":

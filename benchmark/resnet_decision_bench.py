@@ -1,14 +1,21 @@
 #!/usr/bin/env python
-"""Round-5 ResNet decision measurements, all with fence-cancelling
-two-point-fit timing (PROFILE.md round-5 correction):
+"""ResNet decision measurements, all with fence-cancelling repeated
+two-point-fit timing (PROFILE.md round-5 correction + round-6
+median-of-K reproducibility layer via bench._fit_windows):
 
-  a. true Pallas fused-conv rate per shape vs XLA NCHW (was the r4
-     comparison real or fence artifact?)
+  a. v2 Pallas fused-conv rate per shape vs XLA NCHW — now with a
+     BACKWARD row per shape (the v2 Pallas dx/dW kernels vs XLA's
+     transpose-conv autodiff), covering the four key 3x3 shapes PLUS the
+     strided and 1x1 projection kernels
   b. whole-model train step at batch 128 vs 256 (r3's "flat batch
      scaling" was fence-biased)
-  c. BN use_global_stats ablation (re-validate the ~12 ms stat cost)
+  c. BN use_global_stats ablation (re-validate the ~15.3 ms stat cost)
+  d. whole-model fused_resnet50_v1 vs zoo resnet50_v1 train step — the
+     row that decides whether the 15.3 ms BN-stat prize is claimed
+     (fused >= zoo - 5% flips the BENCH headline to the fused model)
 
-Usage: python benchmark/resnet_decision_bench.py [--which a,b,c]
+Runs unchanged on the next TPU tier pass:
+    python benchmark/resnet_decision_bench.py [--which a,b,c,d]
 """
 
 from __future__ import annotations
@@ -49,7 +56,17 @@ def fit_time(run, n1, n2, reps=2):
     return per, times[n1] - per * n1
 
 
-def part_a():
+# (ci, co, hw, k, stride, name) — the four key 3x3 shapes, the strided
+# 3x3 + 1x1 downsample projections, and two 1x1 body projections
+SHAPES_A = [
+    (64, 64, 56, 3, 1, "l1.c2"), (128, 128, 28, 3, 1, "l2.c2"),
+    (256, 256, 14, 3, 1, "l3.c2"), (512, 512, 7, 3, 1, "l4.c2"),
+    (128, 128, 56, 3, 2, "l2.c2s"), (256, 512, 56, 1, 2, "l2.ds"),
+    (256, 64, 56, 1, 1, "l1.c1b"), (1024, 256, 14, 1, 1, "l3.c1b"),
+]
+
+
+def part_a(batch=128):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -57,23 +74,23 @@ def part_a():
     from incubator_mxnet_tpu.ops.pallas_conv import fused_conv_bn
 
     rs = np.random.RandomState(0)
-    shapes = [(64, 64, 56, 3, "l1.c2"), (128, 128, 28, 3, "l2.c2"),
-              (256, 256, 14, 3, "l3.c2"), (512, 512, 7, 3, "l4.c2"),
-              (256, 64, 56, 1, "l1.c1b"), (1024, 256, 14, 1, "l3.c1b")]
     with jax.default_matmul_precision("default"):
-        for ci, co, hw, k, name in shapes:
+        for ci, co, hw, k, stride, name in SHAPES_A:
             pad = (k - 1) // 2
-            xh = jnp.asarray(rs.rand(128, hw, hw, ci), jnp.bfloat16)
+            xh = jnp.asarray(rs.rand(batch, hw, hw, ci), jnp.bfloat16)
             wh = jnp.asarray(rs.rand(k, k, ci, co) * 0.1, jnp.bfloat16)
             g = jnp.asarray(rs.rand(ci).astype(np.float32) + 0.5)
             b = jnp.asarray(rs.rand(ci).astype(np.float32))
 
+            def pfwd(c, w_):
+                return fused_conv_bn(c, w_, g, b, stride=stride, pad=pad,
+                                     relu=True, interpret=False)
+
             def pbody(i, c):
-                y, s, ss = fused_conv_bn(c, wh, g, b, stride=1, pad=pad,
-                                         relu=True, interpret=False)
+                y, s, ss = pfwd(c, wh)
                 # keep stats alive in the chain (DCE guard) either way
                 upd = ((s[0] + ss[0]) * 1e-20).astype(c.dtype)
-                if ci == co:
+                if ci == co and stride == 1:
                     return c * 0.9 + y * 1e-6 + upd
                 return c * 0.9 + upd
 
@@ -81,27 +98,53 @@ def part_a():
                 lambda kk: lax.fori_loop(0, kk, pbody, xh),
                 static_argnums=0)
 
-            xc = jnp.asarray(rs.rand(128, ci, hw, hw), jnp.bfloat16)
+            # backward: grad of a scalarized head through the fused
+            # kernel == one dx + one dW Pallas kernel + the folded BN
+            # cotangents (MXTPU_CONV_BWD governs dispatch)
+            def ploss(c, w_):
+                y, s, ss = pfwd(c, w_)
+                return (jnp.sum(y.astype(jnp.float32)) * 1e-6
+                        + jnp.sum(s) * 1e-8 + jnp.sum(ss) * 1e-10)
+
+            pgrad = jax.grad(ploss, argnums=(0, 1))
+
+            def pbwd_body(i, c):
+                dx, dw = pgrad(c, wh)
+                # fold dw into the carry too — an unused dW contraction
+                # would be DCE'd and the row would time only dx
+                dwdep = (jnp.sum(dw.astype(jnp.float32)) * 1e-20
+                         ).astype(c.dtype)
+                return c * 0.9 + dx.astype(c.dtype) * 1e-6 + dwdep
+
+            pbrun = jax.jit(
+                lambda kk: lax.fori_loop(0, kk, pbwd_body, xh),
+                static_argnums=0)
+
+            xc = jnp.asarray(rs.rand(batch, ci, hw, hw), jnp.bfloat16)
             wc = jnp.asarray(rs.rand(co, ci, k, k) * 0.1, jnp.bfloat16)
             dn = lax.conv_dimension_numbers(
                 xc.shape, wc.shape, ("NCHW", "OIHW", "NCHW"))
             gc = g.reshape(1, ci, 1, 1)
             bc = b.reshape(1, ci, 1, 1)
 
-            def xbody(i, c):
+            def xfwd(c, w_):
                 xn = jnp.maximum(c.astype(jnp.float32) * gc + bc, 0.0
                                  ).astype(c.dtype)
                 y = lax.conv_general_dilated(
-                    xn, wc, (1, 1), [(pad, pad), (pad, pad)],
+                    xn, w_, (stride, stride), [(pad, pad), (pad, pad)],
                     dimension_numbers=dn)
                 y32 = y.astype(jnp.float32)
                 s = jnp.sum(y32, axis=(0, 2, 3))
                 ss = jnp.sum(y32 * y32, axis=(0, 2, 3))
+                return y, s, ss
+
+            def xbody(i, c):
+                y, s, ss = xfwd(c, wc)
                 # fold the stats into the carry so XLA cannot DCE the
                 # two reduction passes (review r5: ci==co shapes were
                 # silently dropping them, biasing the comparison)
                 upd = ((s[0] + ss[0]) * 1e-20).astype(c.dtype)
-                if ci == co:
+                if ci == co and stride == 1:
                     return c * 0.9 + y * 1e-6 + upd
                 return c * 0.9 + upd
 
@@ -109,15 +152,43 @@ def part_a():
                 lambda kk: lax.fori_loop(0, kk, xbody, xc),
                 static_argnums=0)
 
-            fl = 2 * 128 * hw * hw * ci * co * k * k
-            try:
-                pp, _ = fit_time(prun, 10, 40)
-                pal = f"{pp * 1e3:7.3f} ms {fl / pp / 1e12:6.1f} TF/s"
-            except Exception as e:
-                pal = f"FAIL {str(e)[:60]}"
-            xp, _ = fit_time(xrun, 10, 40)
-            print(f"{name:7s} pallas {pal} | xla+bn {xp * 1e3:7.3f} ms "
-                  f"{fl / xp / 1e12:6.1f} TF/s", flush=True)
+            def xloss(c, w_):
+                y, s, ss = xfwd(c, w_)
+                return (jnp.sum(y.astype(jnp.float32)) * 1e-6
+                        + jnp.sum(s) * 1e-8 + jnp.sum(ss) * 1e-10)
+
+            xgrad = jax.grad(xloss, argnums=(0, 1))
+
+            def xbwd_body(i, c):
+                dx, dw = xgrad(c, wc)
+                dwdep = (jnp.sum(dw.astype(jnp.float32)) * 1e-20
+                         ).astype(c.dtype)
+                return c * 0.9 + dx.astype(c.dtype) * 1e-6 + dwdep
+
+            xbrun = jax.jit(
+                lambda kk: lax.fori_loop(0, kk, xbwd_body, xc),
+                static_argnums=0)
+
+            fl = 2 * batch * (hw // stride) ** 2 * ci * co * k * k
+            rows = [("fwd", prun, xrun, fl),
+                    # the grad row executes fwd + dx + dW (the loss
+                    # depends on sum(ss) whose cotangent needs y, so the
+                    # forward cannot be DCE'd; the fused custom_vjp runs
+                    # its forward for residuals either way) ~ 3x fl
+                    ("f+b", pbrun, xbrun, 3 * fl)]
+            for tag, pr, xr, fl_ in rows:
+                try:
+                    pp, _ = fit_time(pr, 10, 40)
+                    pal = f"{pp * 1e3:7.3f} ms {fl_ / pp / 1e12:6.1f} TF/s"
+                except Exception as e:
+                    pal = f"FAIL {str(e)[:60]}"
+                try:
+                    xp, _ = fit_time(xr, 10, 40)
+                    xla = f"{xp * 1e3:7.3f} ms {fl_ / xp / 1e12:6.1f} TF/s"
+                except Exception as e:
+                    xla = f"FAIL {str(e)[:60]}"
+                print(f"{name:7s} {tag} pallas {pal} | xla+bn {xla}",
+                      flush=True)
 
 
 def _trainer(batch_per_chip, use_global_stats=False):
@@ -182,12 +253,55 @@ def part_c():
           f"{128 / per:.0f} img/s/chip", flush=True)
 
 
+def part_d():
+    """Whole-model fused_resnet50_v1 vs zoo resnet50_v1 train step (the
+    prize row): fused >= zoo - 5% means the BN-stat savings survived the
+    kernel swap end-to-end and the BENCH headline flips to the fused
+    model (VERDICT r5 item 2's 'done' bar)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import fused_resnet
+
+    batch = 128 * len(jax.devices())
+    rs = np.random.RandomState(0)
+    results = {}
+    for label, ctor in (("zoo", vision.resnet50_v1),
+                        ("fused", fused_resnet.fused_resnet50_v1)):
+        net = ctor(classes=1000)
+        net.initialize(init="xavier")
+        net.cast("bfloat16")
+        net(mx.nd.zeros((2, 3, 224, 224), dtype="bfloat16"))
+        mesh = parallel.make_mesh({"data": -1})
+        tr = parallel.SPMDTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+        sh = NamedSharding(mesh, PartitionSpec("data"))
+        x = jax.device_put(jnp.asarray(rs.rand(batch, 3, 224, 224),
+                                       jnp.bfloat16), sh)
+        y = jax.device_put(jnp.asarray(rs.randint(0, 1000, (batch,)),
+                                       np.float32), sh)
+        per = _steps_fit(tr, x, y)
+        results[label] = per
+        print(f"{label:5s} train step: {per * 1e3:.1f} ms/step "
+              f"{batch / per:.0f} img/s", flush=True)
+        del tr, x, y, net
+    ratio = results["zoo"] / results["fused"]
+    verdict = "PRIZE CLAIMED" if ratio >= 0.95 else "still behind"
+    print(f"fused/zoo speed ratio {ratio:.3f} (>=0.95 flips the BENCH "
+          f"headline) -> {verdict}", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--which", default="a,b,c")
+    ap.add_argument("--which", default="a,b,c,d")
     args = ap.parse_args()
     for part in args.which.split(","):
-        {"a": part_a, "b": part_b, "c": part_c}[part]()
+        {"a": part_a, "b": part_b, "c": part_c, "d": part_d}[part]()
 
 
 if __name__ == "__main__":

@@ -150,11 +150,98 @@ config.register(
     "kernels (the cuDNN algo-selection analog: reference "
     "src/operator/nn/cudnn/ autotune registry).")
 config.register(
+    "MXTPU_BENCH_FIT_K", 3, int,
+    "Number of independent two-point fits per bench.py metric; the "
+    "recorded value is the median and the spread rides the BENCH json "
+    "line's `fit` field (round-6 reproducibility layer — a single fit's "
+    "slope skews 1.5-2x under +-20-30% PJRT-tunnel transients, the root "
+    "cause of the BENCH_r05 vs PROFILE.md MFU disagreements).")
+config.register(
+    "MXTPU_CONV_OC_BLOCK", 0, int,
+    "Output-channel block size for the fused Pallas conv kernels "
+    "(ops/pallas_conv.py v2). 0 = auto: the largest divisor of Co from "
+    "{Co, 256, 128} whose weight block stays under ~2 MiB — shrinking "
+    "the VMEM-resident weight block frees space for more images per "
+    "grid program, which feeds the MXU's M dimension at small spatial "
+    "extents (the PROFILE.md 512ch@7^2 losing shape).")
+config.register(
+    "MXTPU_CONV_ROW_TARGET", 2048, int,
+    "Matmul-row target (images-per-program * out_h * out_w) for the "
+    "fused Pallas conv kernels; the batch block size nb is chosen to "
+    "reach it subject to the VMEM budget. Raise on hardware with more "
+    "VMEM; lower if the Mosaic compiler rejects a shape.")
+config.register(
+    "MXTPU_CONV_VMEM_MB", 10, int,
+    "Per-program VMEM budget (MiB) assumed by the fused Pallas conv "
+    "block-size heuristics (v5e has ~16 MiB per core; headroom is left "
+    "for Mosaic's own scratch).")
+config.register(
+    "MXTPU_CONV_IM2COL", False, _parse_bool,
+    "Opt-in deep-contraction im2col strategy for the fused Pallas conv "
+    "forward when Ci < 128 lanes (a single (nb*ho*wo, kh*kw*ci) patch "
+    "matmul instead of one matmul per tap). Off by default: the VMEM "
+    "concatenate trips a Mosaic layout bug for some channel counts.")
+config.register(
+    "MXTPU_CONV_BWD", "auto", str,
+    "Backward implementation for the fused Pallas conv+BN kernels: "
+    "'auto' (default) runs the Pallas dx/dW kernels at stride 1 and the "
+    "Pallas dW everywhere, keeping the XLA transpose-conv dx for "
+    "strided convs until the phase-stack pattern is proven on the TPU "
+    "tier; 'pallas' forces every shape through the Pallas kernels; "
+    "'xla' restores the round-4 vjp-over-XLA backward.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "
     "naive-engine + MXNET_ENGINE_TYPE debugging tier. Heavy: disables "
     "async dispatch wins; use for fault isolation only.")
+
+
+def generate_env_vars_md() -> str:
+    """Render the knob registry as ``docs/ENV_VARS.md`` (the reference's
+    env_var.md analog — SURVEY.md §5 config row / VERDICT r5 item 8).
+    ``tests/test_tooling.py`` asserts the committed file matches this
+    output, so the doc can never drift from the registry; regenerate with
+
+        python -c "from incubator_mxnet_tpu.config import write_env_vars_md; write_env_vars_md()"
+    """
+    lines = [
+        "# Environment variables",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. Emitted from the "
+        "`incubator_mxnet_tpu.config` knob registry; regenerate with "
+        "`python -c \"from incubator_mxnet_tpu.config import "
+        "write_env_vars_md; write_env_vars_md()\"`. A sync test in "
+        "tests/test_tooling.py fails when this file is stale. -->",
+        "",
+        "Every knob is read lazily via the typed registry in "
+        "`incubator_mxnet_tpu/config.py`. The `MXNET_*` spelling of each "
+        "name is accepted as an alias for drop-in reference scripts; "
+        "runtime overrides via `config.set(name, value)` take precedence "
+        "over the environment.",
+        "",
+        "| name | type | default | description |",
+        "|---|---|---|---|",
+    ]
+    type_names = {_parse_bool: "bool"}
+    for knob in sorted(config._knobs.values(), key=lambda k: k.name):
+        tname = type_names.get(knob.type,
+                               getattr(knob.type, "__name__", str(knob.type)))
+        doc = " ".join(knob.doc.split()).replace("|", "\\|")
+        lines.append(f"| `{knob.name}` | {tname} | `{knob.default!r}` "
+                     f"| {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_env_vars_md(path: Optional[str] = None) -> str:
+    """Write :func:`generate_env_vars_md` to ``docs/ENV_VARS.md``."""
+    if path is None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "docs", "ENV_VARS.md")
+    with open(path, "w") as f:
+        f.write(generate_env_vars_md())
+    return path
 
 
 def apply_debug_nans() -> None:

@@ -18,6 +18,13 @@ Layout divergences from the unfused zoo model (documented, deliberate):
 
 The 7x7 stem (C_in=3 starves the MXU lane dimension) and the residual
 join run in plain XLA.
+
+Backward (round 6): each fused conv's custom vjp runs the v2 Pallas
+backward kernels — the dx transpose-conv with the BN-statistics
+cotangents folded in VMEM and the dW contraction with in-VMEM prologue
+recompute — replacing the XLA NHWC transpose-conv backward that kept
+this model 1.8x behind the zoo end-to-end (``MXTPU_CONV_BWD`` governs
+dispatch; docs/TRAINING.md "Fused ResNet").
 """
 
 from __future__ import annotations

@@ -1,11 +1,13 @@
 """Pallas fused Conv2D + BatchNorm epilogue/prologue — the cuDNN
 ``ConvolutionBiasActivationForward`` / BN-genstats analog for TPU.
 
-Why this exists (PROFILE.md, rounds 2-3): in ResNet training ~30% of the
+Why this exists (PROFILE.md, rounds 2-5): in ResNet training ~30% of the
 step is BatchNorm statistics passes that XLA cannot fuse into the adjacent
 convolutions — every BN re-reads the conv output from HBM to reduce
 per-channel mean/var, and the normalize-apply is another full read+write.
-The reference solves the same problem with cuDNN fused kernels
+The round-5 decision record quantifies the prize at **15.3 ms/step**
+(2,454 -> ~3,360 img/s at batch 128 if the stat passes disappear). The
+reference solves the same problem with cuDNN fused kernels
 (``src/operator/nn/cudnn/`` — SURVEY.md §2.1 operator-library row); the
 TPU-native solve is a Pallas conv kernel that
 
@@ -16,22 +18,57 @@ TPU-native solve is a Pallas conv kernel that
   output while the tile is still in VMEM (stats epilogue — the separate
   stat pass disappears).
 
-A chain of these kernels (a ResNet bottleneck) touches HBM once per conv
-in the forward instead of three times.
+v2 kernel structure (this round — PROFILE.md named the three levers after
+the per-shape fit table showed 128ch@28² and 512ch@7² losing 2.7-3.6x):
+
+* **output-channel blocking**: the grid is ``(co/bc, n/nb)`` so each
+  program contracts into a ``bc``-wide output block. Shrinking the weight
+  block frees VMEM for more images per program, which is what feeds the
+  MXU's M dimension at small spatial extents (512ch@7² went from nb=8 /
+  392 matmul rows to nb-limited-by-batch with bc=128).
+* **weight-stationary accumulation**: the batch dimension is the INNER
+  grid dimension, so the weight block (and the stats accumulators) stay
+  resident in VMEM across the whole batch sweep; only x/y blocks stream.
+* **DMA pipelining**: streaming x/y blocks over the inner grid dimension
+  is exactly what the Pallas pipeline emitter double-buffers — the next
+  batch block's HBM->VMEM copy overlaps the current block's MXU work,
+  and the ky/kx taps slice from the VMEM-resident x block (no HBM
+  traffic per tap).
+
+The strided and 1x1 projection kernels get the same treatment (strided
+convs now take nb>1 via a per-image unrolled phase decomposition — the
+batched 6-D strided reshape is still rejected by Mosaic).
+
+**Backward (v2, new)**: two Pallas kernels replace the XLA NHWC
+transpose-conv backward that kept ``fused_resnet50_v1`` 1.8x behind the
+zoo model end-to-end:
+
+* ``dx`` — a transpose-conv kernel whose PROLOGUE folds the BN-statistics
+  cotangents into the output cotangent in VMEM (``dy_t = dy + ds +
+  2*y*dss`` — the BN-backward; dy_t is never materialised in HBM) and
+  whose EPILOGUE emits the per-channel prologue-parameter sums
+  (``da = Σ dxp*relu'*x``, ``db = Σ dxp*relu'``) while the tile is
+  resident — the backward analog of the forward stats epilogue.
+* ``dW`` — the weight-gradient contraction (per-tap ``xsᵀ @ dy_t`` into a
+  VMEM-resident fp32 ``dW`` accumulator) with the same BN-backward
+  prologue recomputing ``x_pro`` and ``dy_t`` in VMEM.
+
+``MXTPU_CONV_BWD`` selects the implementation: ``auto`` (default) runs
+the Pallas kernels for the stride-1 shapes (51 of ResNet-50's 53 convs)
+and keeps the XLA formulation for strided convs until the phase-stack
+pattern is proven on the TPU tier; ``pallas`` forces every shape through
+the kernels; ``xla`` restores the round-4 path (vjp over
+:func:`_conv_part_ref`).
 
 Kernel shape contract (ResNet family): NHWC, square kernels 1x1/3x3
 (arbitrary odd sizes accepted), stride 1 or 2, symmetric padding, no
 groups/dilation. The 7x7 stem (C_in=3 wastes the MXU lane dim) and the
 residual join stay in XLA.
 
-Backward is ``jax.vjp`` over the XLA reference formulation (the raw conv
-output is linear in (x, w), so XLA DCEs the dead forward conv and keeps
-only the transpose convs + cheap prologue recompute); the BN-statistics
-cotangents (d_sum, d_sumsq from the next layer's coefficients) flow
-automatically.
-
-On non-TPU backends the kernel runs through the Pallas interpreter so the
-correctness suite covers it on the CPU mesh.
+On non-TPU backends the kernels run through the Pallas interpreter so the
+correctness suite covers every variant on the CPU mesh
+(tests/test_pallas_conv.py — forward, dx, dW, da/db each oracle-proven
+against the XLA formulation).
 """
 
 from __future__ import annotations
@@ -43,6 +80,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..config import config
 from .registry import register
 
 
@@ -51,63 +89,114 @@ def _prec(dtype):
             else lax.Precision.HIGHEST)
 
 
+def _low_prec(dtype):
+    return dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _esz(dtype):
+    return 2 if _low_prec(dtype) else 4
+
+
+def _out_size(h, pad, k, stride):
+    return (h + 2 * pad - k) // stride + 1
+
+
+# ---------------------------------------------------------------------------
+# shared in-kernel helpers
+# ---------------------------------------------------------------------------
+
+def _pad_input(x, pad, stride):
+    """Symmetric padding; extra (stride-1) bottom/right padding keeps the
+    strided slice-reshape uniform for odd sizes (those rows are never
+    selected)."""
+    if pad or stride > 1:
+        return jnp.pad(x, ((0, 0), (pad, pad + stride - 1),
+                           (pad, pad + stride - 1), (0, 0)))
+    return x
+
+
+def _make_tap(x, stride, ho, wo, nb, ci):
+    """Return ``tap(ky, kx) -> (nb*ho*wo, ci)`` slicing the padded VMEM
+    block. stride>1 uses the per-image phase decomposition: one reshape
+    into stride-phases per image, then every tap is a PLAIN slice (offset
+    strided slices at tap offsets and the batched 6-D strided reshape are
+    both rejected by the Mosaic compiler — the unroll is per-image)."""
+    if stride == 1:
+        def tap(ky, kx):
+            return x[:, ky:ky + ho, kx:kx + wo, :].reshape(nb * ho * wo, ci)
+        return tap
+
+    s = stride
+    hp, wp = x.shape[1], x.shape[2]
+    hp -= hp % s
+    wp -= wp % s
+    xphs = [x[img, :hp, :wp, :].reshape(hp // s, s, wp // s, s, ci)
+            for img in range(nb)]
+
+    def tap(ky, kx):
+        qy, ry = divmod(ky, s)
+        qx, rx = divmod(kx, s)
+        parts = [xph[qy:qy + ho, ry, qx:qx + wo, rx, :].reshape(ho * wo, ci)
+                 for xph in xphs]
+        return parts[0] if nb == 1 else jnp.concatenate(parts, axis=0)
+    return tap
+
+
+def _prologue(x, a_row, b_row, relu):
+    """BN scale/shift (+ReLU) of the previous layer, in fp32, cast back."""
+    xf = x.astype(jnp.float32) * a_row[None, None, None, :] \
+        + b_row[None, None, None, :]
+    if relu:
+        xf = jnp.maximum(xf, 0.0)
+    return xf.astype(x.dtype)
+
+
+def _fold_bn_cotangents(dy, y, ds_row, dss_row):
+    """BN-backward prologue: fold the stats cotangents into the output
+    cotangent — ``d(sum)/dy = 1`` and ``d(sumsq)/dy = 2y`` with the SAVED
+    kernel output. fp32, cast to the compute dtype by the caller."""
+    return (dy.astype(jnp.float32) + ds_row[None, None, None, :]
+            + 2.0 * y.astype(jnp.float32) * dss_row[None, None, None, :])
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
 def _fused_conv_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, ss_ref, *,
                        stride, pad, relu, kh, kw, ho, wo, has_pro, nb,
                        im2col):
-    """``nb`` batch images per grid program: prologue -> pad -> conv as
-    MXU matmuls (fp32 accumulation) -> stats epilogue.
+    """One ``(co-block, batch-block)`` grid program: prologue -> pad ->
+    conv as MXU matmuls (fp32 accumulation) -> stats epilogue.
+
+    Grid order is (co-block OUTER, batch-block INNER): the weight block
+    and the stats accumulators stay VMEM-resident across the inner batch
+    sweep (weight-stationary) while x/y blocks stream double-buffered.
 
     Two matmul strategies: ``im2col`` gathers the kh*kw shifted views into
     one (nb*ho*wo, kh*kw*ci) patch matrix in VMEM for a single deep-
     contraction matmul (best when ci < 128 lanes); otherwise one matmul
-    per (ky, kx) tap."""
+    per (ky, kx) tap against the resident weight block."""
     from jax.experimental import pallas as pl
 
     x = x_ref[...]                                 # (nb, H, W, Ci)
     ci = x.shape[-1]
-    co = w_ref.shape[-1]
+    bc = w_ref.shape[-1]
     prec = _prec(x.dtype)
     if has_pro:
-        xf = x.astype(jnp.float32) * a_ref[0][None, None, None, :] \
-            + b_ref[0][None, None, None, :]
-        if relu:
-            xf = jnp.maximum(xf, 0.0)
-        x = xf.astype(x_ref.dtype)
-    # extra (stride-1) bottom/right padding keeps the strided slice-
-    # reshape uniform for odd sizes; those rows are never selected
-    if pad or stride > 1:
-        x = jnp.pad(x, ((0, 0), (pad, pad + stride - 1),
-                        (pad, pad + stride - 1), (0, 0)))
-
-    if stride > 1:
-        # phase decomposition: one reshape into stride-phases, then every
-        # tap is a PLAIN slice (offset strided slices at tap offsets are
-        # rejected by the Mosaic compiler). nb == 1 for strided convs.
-        s = stride
-        hp, wp = x.shape[1], x.shape[2]
-        hp -= hp % s
-        wp -= wp % s
-        xph = x[0, :hp, :wp, :].reshape(hp // s, s, wp // s, s, ci)
-
-    def tap(ky, kx):
-        if stride == 1:
-            xs = x[:, ky:ky + ho, kx:kx + wo, :]
-        else:
-            s = stride
-            qy, ry = ky // s, ky % s
-            qx, rx = kx // s, kx % s
-            xs = xph[qy:qy + ho, ry, qx:qx + wo, rx, :]
-        return xs.reshape(nb * ho * wo, ci)
+        x = _prologue(x, a_ref[0], b_ref[0], relu)
+    x = _pad_input(x, pad, stride)
+    tap = _make_tap(x, stride, ho, wo, nb, ci)
 
     if im2col and (kh, kw) != (1, 1):
         patches = jnp.concatenate(
             [tap(ky, kx) for ky in range(kh) for kx in range(kw)], axis=-1)
         acc = lax.dot_general(
-            patches, w_ref[...].reshape(kh * kw * ci, co),
+            patches, w_ref[...].reshape(kh * kw * ci, bc),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
     else:
-        acc = jnp.zeros((nb * ho * wo, co), jnp.float32)
+        acc = jnp.zeros((nb * ho * wo, bc), jnp.float32)
         for ky in range(kh):
             for kx in range(kw):
                 acc = acc + lax.dot_general(
@@ -115,9 +204,9 @@ def _fused_conv_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, ss_ref, *,
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32, precision=prec)
 
-    y_ref[...] = acc.reshape(nb, ho, wo, co).astype(y_ref.dtype)
+    y_ref[...] = acc.reshape(nb, ho, wo, bc).astype(y_ref.dtype)
 
-    @pl.when(pl.program_id(0) == 0)
+    @pl.when(pl.program_id(1) == 0)
     def _init():
         s_ref[...] = jnp.zeros_like(s_ref)
         ss_ref[...] = jnp.zeros_like(ss_ref)
@@ -126,39 +215,67 @@ def _fused_conv_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, ss_ref, *,
     ss_ref[0] += jnp.sum(acc * acc, axis=0)
 
 
-def _out_size(h, pad, k, stride):
-    return (h + 2 * pad - k) // stride + 1
+# ---------------------------------------------------------------------------
+# block-size heuristics (shared by fwd and bwd)
+# ---------------------------------------------------------------------------
+
+def _vmem_budget():
+    return int(config.get("MXTPU_CONV_VMEM_MB")) * 1024 * 1024
 
 
-def _fused_conv_ref(x, w, a, b, stride, pad, relu):
-    """XLA formulation with matching math (prologue in fp32, fp32-
-    accumulated conv, stats in fp32). Oracle for tests; the backward
-    linearizes through :func:`_conv_part_ref` (the same body minus the
-    stats)."""
-    y = _conv_part_ref(x, w, a, b, stride, pad, relu)
-    y32 = y.astype(jnp.float32)
-    s = jnp.sum(y32, axis=(0, 1, 2))
-    ss = jnp.sum(y32 * y32, axis=(0, 1, 2))
-    return y32.astype(x.dtype), s, ss
+def _pick_oc_block(co, weight_bytes_per_co):
+    """Output-channel block: the largest divisor of ``co`` from
+    {co, 256, 128} whose weight block fits the per-block weight budget
+    (~2 MiB). Shrinking the resident weight block is what frees VMEM for
+    more images per program at the 512ch@7² class of shapes."""
+    knob = int(config.get("MXTPU_CONV_OC_BLOCK") or 0)
+    if knob and co % knob == 0 and knob <= co:
+        return knob
+    budget = 2 * 1024 * 1024
+    for cand in (co, 256, 128):
+        if cand <= co and co % cand == 0 \
+                and cand * weight_bytes_per_co <= budget:
+            return cand
+    return 128 if co % 128 == 0 else co
 
 
 def _pick_nb(n, ho, wo, *, per_image_bytes=0, fixed_bytes=0, stride=1):
-    """Images per grid program: aim for ~1-2k matmul rows so the MXU's
-    M dimension is well fed even at 7x7 spatial sizes, capped so the
-    per-program working set stays under the VMEM budget (v5e has ~16 MB;
-    nb=32 at the layer-4 shapes crashes the Mosaic compile helper).
-    Strided convs use nb=1 — the 6-D strided slice-reshape is rejected."""
-    if stride > 1:
-        return 1
-    target = 2048
+    """Images per grid program: aim for the knob's matmul-row target
+    (default 2048) so the MXU's M dimension is well fed even at 7x7
+    spatial sizes, capped so the per-program working set stays under the
+    VMEM budget (v5e has ~16 MB; nb=32 at the layer-4 shapes crashes the
+    Mosaic compile helper). Strided convs unroll per image, so their nb
+    is additionally capped at 8 to bound kernel code size."""
+    target = int(config.get("MXTPU_CONV_ROW_TARGET"))
     nb = max(1, target // max(ho * wo, 1))
-    budget = 10 * 1024 * 1024
+    if stride > 1:
+        nb = min(nb, 8)
+    budget = _vmem_budget()
     if per_image_bytes:
         nb = min(nb, max(1, (budget - fixed_bytes) // per_image_bytes))
+    nb = min(nb, n)
     while n % nb:
         nb -= 1
     return nb
 
+
+def _compiler_params(interpret, semantics):
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.TPUCompilerParams(
+        dimension_semantics=semantics)}
+
+
+def _use_im2col(ci, kh, kw):
+    return (bool(config.get("MXTPU_CONV_IM2COL"))
+            and ci < 128 and (kh, kw) != (1, 1))
+
+
+# ---------------------------------------------------------------------------
+# forward pallas_call
+# ---------------------------------------------------------------------------
 
 def _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret):
     from jax.experimental import pallas as pl
@@ -172,37 +289,35 @@ def _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret):
     if not has_pro:  # dummy operands keep one kernel signature
         a = jnp.ones((ci,), jnp.float32)
         b = jnp.zeros((ci,), jnp.float32)
-    esz = 2 if x.dtype in (jnp.bfloat16, jnp.float16) else 4
+    esz = _esz(x.dtype)
+    bc = _pick_oc_block(co, kh * kw * ci * esz)
     # double-buffered x and y blocks + the fp32 accumulator, per image
     per_img = 2 * ((h + 2 * pad) * (wdt + 2 * pad) * ci
-                   + ho * wo * co) * esz + ho * wo * co * 4
+                   + ho * wo * bc) * esz + ho * wo * bc * 4
     nb = _pick_nb(n, ho, wo, per_image_bytes=per_img,
-                  fixed_bytes=kh * kw * ci * co * esz, stride=stride)
+                  fixed_bytes=kh * kw * ci * bc * esz, stride=stride)
     # deep-contraction im2col pays off when the per-tap contraction is
     # shallower than the MXU's 128 lanes — but the VMEM concatenate
     # currently trips a Mosaic layout bug ("result/input offset mismatch
     # on non-concat dimension") for some channel counts, so it is opt-in
-    import os
-
-    im2col = (os.environ.get("MXTPU_CONV_IM2COL", "0") == "1"
-              and ci < 128 and (kh, kw) != (1, 1))
+    im2col = _use_im2col(ci, kh, kw)
 
     kernel = functools.partial(
         _fused_conv_kernel, stride=stride, pad=pad, relu=relu, kh=kh,
         kw=kw, ho=ho, wo=wo, has_pro=has_pro, nb=nb, im2col=im2col)
     y, s, ss = pl.pallas_call(
         kernel,
-        grid=(n // nb,),
+        grid=(co // bc, n // nb),
         in_specs=[
-            pl.BlockSpec((nb, h, wdt, ci), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((kh, kw, ci, co), lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec((1, ci), lambda i: (0, 0)),
-            pl.BlockSpec((1, ci), lambda i: (0, 0)),
+            pl.BlockSpec((nb, h, wdt, ci), lambda j, i: (i, 0, 0, 0)),
+            pl.BlockSpec((kh, kw, ci, bc), lambda j, i: (0, 0, 0, j)),
+            pl.BlockSpec((1, ci), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, ci), lambda j, i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((nb, ho, wo, co), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, co), lambda i: (0, 0)),
-            pl.BlockSpec((1, co), lambda i: (0, 0)),
+            pl.BlockSpec((nb, ho, wo, bc), lambda j, i: (i, 0, 0, j)),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, ho, wo, co), x.dtype),
@@ -210,19 +325,272 @@ def _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret):
             jax.ShapeDtypeStruct((1, co), jnp.float32),
         ],
         interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "arbitrary")),
     )(x, w, a.astype(jnp.float32).reshape(1, ci),
       b.astype(jnp.float32).reshape(1, ci))
     return y, s[0], ss[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _fused_conv(x, w, a, b, stride, pad, relu, interpret):
-    return _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
+# ---------------------------------------------------------------------------
+# backward: dx (transpose conv, BN-backward prologue, da/db epilogue)
+# ---------------------------------------------------------------------------
+
+def _conv_bwd_dx_kernel(dy_ref, y_ref, x_ref, w_ref, a_ref, b_ref, ds_ref,
+                        dss_ref, dx_ref, da_ref, db_ref, *, stride, pad,
+                        relu, kh, kw, h, wsp, ho, wo, has_pro, nb):
+    """dx = transpose-conv(dy_t, w) * prologue-backward.
+
+    Prologue: fold the stats cotangents into dy in VMEM (dy_t never
+    touches HBM). Body: stride-1 is the classic flipped-tap correlation
+    over a (k-1-pad)-padded dy_t; stride>1 decomposes dx into stride²
+    phases, each a plain-slice tap subset sum, re-interleaved by one
+    reshape. Epilogue: per-channel da/db sums of the prologue backward
+    accumulate across the inner batch grid dimension — the backward
+    analog of the forward stats epilogue."""
+    from jax.experimental import pallas as pl
+
+    dy = dy_ref[...]                      # (nb, ho, wo, Co)
+    y = y_ref[...]
+    co = dy.shape[-1]
+    cb = w_ref.shape[2]                   # ci block
+    cdt = y.dtype
+    prec = _prec(cdt)
+    dyt = _fold_bn_cotangents(dy, y, ds_ref[0], dss_ref[0]).astype(cdt)
+
+    def tap_dot(rows):
+        # contract over Co: (M, Co) x (cb, Co) -> (M, cb)
+        return lax.dot_general(
+            rows, w_tap, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+
+    if stride == 1:
+        py, px = kh - 1 - pad, kw - 1 - pad
+        dyp = jnp.pad(dyt, ((0, 0), (py, py), (px, px), (0, 0)))
+        acc = jnp.zeros((nb * h * wsp, cb), jnp.float32)
+        for ky in range(kh):
+            for kx in range(kw):
+                w_tap = w_ref[ky, kx]
+                acc = acc + tap_dot(
+                    dyp[:, kh - 1 - ky:kh - 1 - ky + h,
+                        kw - 1 - kx:kw - 1 - kx + wsp, :].reshape(
+                            nb * h * wsp, co))
+        dxp = acc.reshape(nb, h, wsp, cb)
+    else:
+        s = stride
+        kp = max(kh, kw)
+        dyp = jnp.pad(dyt, ((0, 0), (kp, kp), (kp, kp), (0, 0)))
+        hq = -(-h // s)
+        wq = -(-wsp // s)
+
+        def rows_at(oy, ox):
+            return dyp[:, kp + oy:kp + oy + hq,
+                       kp + ox:kp + ox + wq, :].reshape(nb * hq * wq, co)
+
+        col_phases = []
+        for ri in range(s):
+            row_phases = []
+            for rj in range(s):
+                acc = jnp.zeros((nb * hq * wq, cb), jnp.float32)
+                for ky in range(kh):
+                    if (pad + ri - ky) % s:
+                        continue
+                    oy = (pad + ri - ky) // s
+                    for kx in range(kw):
+                        if (pad + rj - kx) % s:
+                            continue
+                        ox = (pad + rj - kx) // s
+                        acc = acc + lax.dot_general(
+                            rows_at(oy, ox), w_ref[ky, kx],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=prec)
+                row_phases.append(acc.reshape(nb, hq, wq, cb))
+            # (nb, hq, wq, s, cb): interleave the column phases
+            col_phases.append(jnp.stack(row_phases, axis=3))
+        # (nb, hq, s, wq, s, cb) -> (nb, hq*s, wq*s, cb) -> crop
+        ph = jnp.stack(col_phases, axis=2)
+        dxp = ph.reshape(nb, hq * s, wq * s, cb)[:, :h, :wsp, :]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        da_ref[...] = jnp.zeros_like(da_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    if has_pro:
+        x32 = x_ref[...].astype(jnp.float32)
+        lin = x32 * a_ref[0][None, None, None, :] \
+            + b_ref[0][None, None, None, :]
+        mask = (lin > 0.0).astype(jnp.float32) if relu \
+            else jnp.ones_like(lin)
+        dxf = dxp * mask
+        dx_ref[...] = (dxf * a_ref[0][None, None, None, :]).astype(
+            dx_ref.dtype)
+        da_ref[0] += jnp.sum(dxf * x32, axis=(0, 1, 2))
+        db_ref[0] += jnp.sum(dxf, axis=(0, 1, 2))
+    else:
+        dx_ref[...] = dxp.astype(dx_ref.dtype)
+        # da/db stay at their init zeros (no prologue to differentiate)
+
+
+def _conv_bwd_dx_pallas(x, w, a, b, y, dy, ds, dss, stride, pad, relu,
+                        interpret):
+    from jax.experimental import pallas as pl
+
+    n, h, wsp, ci = x.shape
+    kh, kw, _, co = w.shape
+    ho, wo = y.shape[1], y.shape[2]
+    has_pro = a is not None
+    if not has_pro:
+        a = jnp.ones((ci,), jnp.float32)
+        b = jnp.zeros((ci,), jnp.float32)
+    esz = _esz(x.dtype)
+    cb = _pick_oc_block(ci, kh * kw * co * esz)
+    per_img = 2 * (ho * wo * co * 2 + h * wsp * ci + h * wsp * cb) * esz \
+        + h * wsp * cb * 4
+    nb = _pick_nb(n, h, wsp, per_image_bytes=per_img,
+                  fixed_bytes=kh * kw * ci * co * esz, stride=stride)
+    kernel = functools.partial(
+        _conv_bwd_dx_kernel, stride=stride, pad=pad, relu=relu, kh=kh,
+        kw=kw, h=h, wsp=wsp, ho=ho, wo=wo, has_pro=has_pro, nb=nb)
+    dx, da, db = pl.pallas_call(
+        kernel,
+        grid=(ci // cb, n // nb),
+        in_specs=[
+            pl.BlockSpec((nb, ho, wo, co), lambda j, i: (i, 0, 0, 0)),
+            pl.BlockSpec((nb, ho, wo, co), lambda j, i: (i, 0, 0, 0)),
+            pl.BlockSpec((nb, h, wsp, cb), lambda j, i: (i, 0, 0, j)),
+            pl.BlockSpec((kh, kw, cb, co), lambda j, i: (0, 0, j, 0)),
+            pl.BlockSpec((1, cb), lambda j, i: (0, j)),
+            pl.BlockSpec((1, cb), lambda j, i: (0, j)),
+            pl.BlockSpec((1, co), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, co), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, h, wsp, cb), lambda j, i: (i, 0, 0, j)),
+            pl.BlockSpec((1, cb), lambda j, i: (0, j)),
+            pl.BlockSpec((1, cb), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wsp, ci), x.dtype),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32),
+        ],
+        interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "arbitrary")),
+    )(dy, y, x, w,
+      a.astype(jnp.float32).reshape(1, ci),
+      b.astype(jnp.float32).reshape(1, ci),
+      jnp.asarray(ds, jnp.float32).reshape(1, co),
+      jnp.asarray(dss, jnp.float32).reshape(1, co))
+    if not has_pro:
+        return dx, None, None
+    return dx, da[0], db[0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dW (per-tap contraction, BN-backward prologue)
+# ---------------------------------------------------------------------------
+
+def _conv_bwd_dw_kernel(x_ref, dy_ref, y_ref, a_ref, b_ref, ds_ref,
+                        dss_ref, dw_ref, *, stride, pad, relu, kh, kw,
+                        ho, wo, has_pro, nb):
+    """dW[ky,kx] += x_proᵀ(tap ky,kx) @ dy_t, accumulated fp32 in the
+    VMEM-resident dW block across the inner batch grid dimension.
+
+    Prologues recompute ``x_pro`` (forward BN+ReLU of the input tile) and
+    fold the stats cotangents into ``dy_t`` in VMEM — neither is ever
+    materialised in HBM (the XLA backward materialises both)."""
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...]
+    ci = x.shape[-1]
+    bc = dy_ref.shape[-1]
+    cdt = y_ref.dtype
+    prec = _prec(cdt)
+    if has_pro:
+        x = _prologue(x, a_ref[0], b_ref[0], relu)
+    x = _pad_input(x, pad, stride)
+    tap = _make_tap(x, stride, ho, wo, nb, ci)
+
+    dyt = _fold_bn_cotangents(dy_ref[...], y_ref[...], ds_ref[0],
+                              dss_ref[0]).astype(cdt)
+    dyr = dyt.reshape(nb * ho * wo, bc)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    for ky in range(kh):
+        for kx in range(kw):
+            dw_ref[ky, kx] += lax.dot_general(
+                tap(ky, kx), dyr, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec)
+
+
+def _conv_bwd_dw_pallas(x, w, a, b, y, dy, ds, dss, stride, pad, relu,
+                        interpret):
+    from jax.experimental import pallas as pl
+
+    n, h, wsp, ci = x.shape
+    kh, kw, _, co = w.shape
+    ho, wo = y.shape[1], y.shape[2]
+    has_pro = a is not None
+    if not has_pro:
+        a = jnp.ones((ci,), jnp.float32)
+        b = jnp.zeros((ci,), jnp.float32)
+    esz = _esz(x.dtype)
+    bc = _pick_oc_block(co, kh * kw * ci * 4)   # fp32 dW accumulator
+    per_img = 2 * ((h + 2 * pad) * (wsp + 2 * pad) * ci
+                   + 2 * ho * wo * bc) * esz
+    nb = _pick_nb(n, ho, wo, per_image_bytes=per_img,
+                  fixed_bytes=kh * kw * ci * bc * 4, stride=stride)
+    kernel = functools.partial(
+        _conv_bwd_dw_kernel, stride=stride, pad=pad, relu=relu, kh=kh,
+        kw=kw, ho=ho, wo=wo, has_pro=has_pro, nb=nb)
+    dw = pl.pallas_call(
+        kernel,
+        grid=(co // bc, n // nb),
+        in_specs=[
+            pl.BlockSpec((nb, h, wsp, ci), lambda j, i: (i, 0, 0, 0)),
+            pl.BlockSpec((nb, ho, wo, bc), lambda j, i: (i, 0, 0, j)),
+            pl.BlockSpec((nb, ho, wo, bc), lambda j, i: (i, 0, 0, j)),
+            pl.BlockSpec((1, ci), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, ci), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((kh, kw, ci, bc),
+                               lambda j, i: (0, 0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((kh, kw, ci, co), jnp.float32),
+        interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "arbitrary")),
+    )(x, dy, y,
+      a.astype(jnp.float32).reshape(1, ci),
+      b.astype(jnp.float32).reshape(1, ci),
+      jnp.asarray(ds, jnp.float32).reshape(1, co),
+      jnp.asarray(dss, jnp.float32).reshape(1, co))
+    return dw.astype(w.dtype)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference formulation (oracle + fallback backward)
+# ---------------------------------------------------------------------------
+
+def _fused_conv_ref(x, w, a, b, stride, pad, relu):
+    """XLA formulation with matching math (prologue in fp32, fp32-
+    accumulated conv, stats in fp32). Oracle for tests; the backward
+    linearizes through :func:`_conv_part_ref` (the same body minus the
+    stats)."""
+    y = _conv_part_ref(x, w, a, b, stride, pad, relu)
+    y32 = y.astype(jnp.float32)
+    s = jnp.sum(y32, axis=(0, 1, 2))
+    ss = jnp.sum(y32 * y32, axis=(0, 1, 2))
+    return y32.astype(x.dtype), s, ss
 
 
 def _conv_part_ref(x, w, a, b, stride, pad, relu):
     """Prologue + conv only (no stats) — the single XLA body shared by the
-    test oracle (_fused_conv_ref) and the backward linearization.
+    test oracle (_fused_conv_ref) and the fallback backward linearization.
 
     For bf16/f16 inputs the conv runs NATIVELY in the input dtype (the
     MXU still accumulates fp32 internally; only the output rounds) —
@@ -236,12 +604,21 @@ def _conv_part_ref(x, w, a, b, stride, pad, relu):
         x = xf.astype(x.dtype)
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     ("NHWC", "HWIO", "NHWC"))
-    low_prec = x.dtype in (jnp.bfloat16, jnp.float16)
+    low_prec = _low_prec(x.dtype)
     return lax.conv_general_dilated(
         x, w, window_strides=(stride, stride),
         padding=[(pad, pad), (pad, pad)], dimension_numbers=dn,
         preferred_element_type=None if low_prec else jnp.float32,
         precision=_prec(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# custom vjp
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_conv(x, w, a, b, stride, pad, relu, interpret):
+    return _fused_conv_pallas(x, w, a, b, stride, pad, relu, interpret)
 
 
 def _fused_conv_fwd(x, w, a, b, stride, pad, relu, interpret):
@@ -250,23 +627,69 @@ def _fused_conv_fwd(x, w, a, b, stride, pad, relu, interpret):
     return out, (x, w, a, b, y)
 
 
+def _bwd_wants_pallas(stride):
+    """Backward-implementation dispatch (``MXTPU_CONV_BWD``): returns
+    (dx_pallas, dw_pallas). ``auto`` runs both Pallas kernels at stride 1
+    (51/53 ResNet-50 convs) and the Pallas dW everywhere, keeping the XLA
+    dx for strided convs until the phase-stack pattern is proven on the
+    TPU tier; ``pallas`` forces both; ``xla`` restores the r4 path."""
+    mode = str(config.get("MXTPU_CONV_BWD")).lower()
+    if mode == "xla":
+        return False, False
+    if mode == "pallas":
+        return True, True
+    return stride == 1, True
+
+
 def _fused_conv_bwd(stride, pad, relu, interpret, res, cts):
-    """Fold the stats cotangents into the output cotangent by hand —
-    ``d(sum)/dy = 1`` and ``d(sumsq)/dy = 2y`` with the SAVED kernel
-    output — then transpose only prologue+conv. Differentiating the ref's
-    stats directly would make XLA recompute the whole forward conv in the
-    backward (ss's vjp needs y), which measured ~2x on ResNet-50."""
+    """Backward. Pallas path (default, see :func:`_bwd_wants_pallas`):
+    the dx transpose-conv kernel with the BN-backward prologue + da/db
+    epilogue and the dW contraction kernel — the stats cotangents are
+    folded in VMEM with the SAVED kernel output, and dy_t / x_pro are
+    never materialised in HBM.
+
+    XLA fallback: fold the stats cotangents by hand (``d(sum)/dy = 1``,
+    ``d(sumsq)/dy = 2y``) then transpose only prologue+conv via jax.vjp.
+    Differentiating the ref's stats directly would make XLA recompute the
+    whole forward conv in the backward (ss's vjp needs y), which measured
+    ~2x on ResNet-50."""
     x, w, a, b, y = res
     dy, ds, dss = cts
-    dy_t = (dy.astype(jnp.float32) + ds[None, None, None, :]
-            + 2.0 * y.astype(jnp.float32) * dss[None, None, None, :])
-    dy_t = dy_t.astype(y.dtype)
+    dx_pallas, dw_pallas = _bwd_wants_pallas(stride)
+
+    dw = None
+    if dw_pallas:
+        dw = _conv_bwd_dw_pallas(x, w, a, b, y, dy, ds, dss, stride, pad,
+                                 relu, interpret)
+    if dx_pallas:
+        # _bwd_wants_pallas never yields pallas-dx without pallas-dW
+        dx, da, db = _conv_bwd_dx_pallas(x, w, a, b, y, dy, ds, dss,
+                                         stride, pad, relu, interpret)
+        if a is None:
+            return dx, dw, None, None
+        return dx, dw, da, db
+
+    # XLA dx (and dw unless the Pallas dW already ran) — same fold as the
+    # kernels' prologue, materialised since XLA owns the transpose conv
+    dy_t = _fold_bn_cotangents(dy, y, ds, dss).astype(y.dtype)
     if a is None:
+        if dw is not None:
+            _, vjp = jax.vjp(
+                lambda x_: _conv_part_ref(x_, w, None, None, stride, pad,
+                                          relu), x)
+            (dx,) = vjp(dy_t)
+            return dx, dw, None, None
         _, vjp = jax.vjp(
             lambda x_, w_: _conv_part_ref(x_, w_, None, None, stride, pad,
                                           relu), x, w)
-        dx, dw = vjp(dy_t)
-        return dx, dw, None, None
+        dx, dwx = vjp(dy_t)
+        return dx, dwx, None, None
+    if dw is not None:
+        _, vjp = jax.vjp(
+            lambda x_, a_, b_: _conv_part_ref(x_, w, a_, b_, stride, pad,
+                                              relu), x, a, b)
+        dx, da, db = vjp(dy_t)
+        return dx, dw, da, db
     _, vjp = jax.vjp(
         lambda x_, w_, a_, b_: _conv_part_ref(x_, w_, a_, b_, stride, pad,
                                               relu), x, w, a, b)
@@ -290,6 +713,10 @@ def fused_conv_bn(x, w, a=None, b=None, stride=1, pad=0, relu=True,
     prologue activation. Returns ``(y_raw, sum, sumsq)`` where the fp32
     per-channel stats are taken over the raw conv output — feed them to
     :func:`bn_scale_shift` to fold THIS layer's BN into the next call.
+
+    Differentiable: the custom vjp runs the v2 Pallas backward kernels
+    (dx transpose-conv with BN-backward prologue + da/db epilogue; dW
+    contraction) — see ``MXTPU_CONV_BWD`` for the dispatch contract.
     """
     if interpret is None:
         interpret = not pallas_conv_available()

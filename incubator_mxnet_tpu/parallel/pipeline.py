@@ -46,7 +46,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import autograd
 from .. import random as _random
 from ..ndarray import NDArray
-from .mesh import DATA_AXIS, PIPE_AXIS, make_mesh, mesh_scope
+
+from .mesh import (DATA_AXIS, PIPE_AXIS, make_mesh, mesh_scope,
+                   shard_map_compat as _shard_map)
 from .spmd import _to_optax, collect_params, functional_apply
 
 
@@ -136,7 +138,7 @@ def pipeline_apply(stage_fn: Callable[[Dict[str, Any], jax.Array], jax.Array],
         PartitionSpec()
     out_spec = PartitionSpec(None, data_axis) if data_axis else \
         PartitionSpec()
-    y_mb = jax.shard_map(per_device, mesh=mesh,
+    y_mb = _shard_map(per_device, mesh=mesh,
                          in_specs=(pspec, mb_spec),
                          out_specs=out_spec, check_vma=False)(
         stacked_params, x_mb)
@@ -236,7 +238,7 @@ def pipeline_apply_interleaved(
     pspec = jax.tree.map(lambda _: PartitionSpec(pipe_axis), reordered)
     mb_spec = PartitionSpec(None, data_axis) if data_axis else \
         PartitionSpec()
-    y_mb = jax.shard_map(per_device, mesh=mesh,
+    y_mb = _shard_map(per_device, mesh=mesh,
                          in_specs=(pspec, mb_spec),
                          out_specs=mb_spec, check_vma=False)(
         reordered, x_mb)
@@ -389,7 +391,7 @@ def pipeline_apply_1f1b(stage_fn, stacked_params, x, labels, per_mb_loss,
         PartitionSpec()
     epi_p = epilogue_params if epilogue_params is not None else {}
     epi_spec = jax.tree.map(lambda _: PartitionSpec(), epi_p)
-    loss_v, dx_mb, grads, epi_grads = jax.shard_map(
+    loss_v, dx_mb, grads, epi_grads = _shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec, epi_spec, mb_spec, mb_spec),
         out_specs=(PartitionSpec(), mb_spec, pspec, epi_spec),

@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import SEQ_AXIS
+from .mesh import SEQ_AXIS, axis_size_compat
 
 
 def _flash_block(q, k, v, m_prev, l_prev, o_prev, causal_mask=None):
@@ -62,7 +62,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     Call inside shard_map/pjit; q/k/v are the LOCAL shards (B, H, T_local,
     D). KV rotates n_shards times around the ring.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my_idx = lax.axis_index(axis_name)
     tq = q.shape[2]
 
@@ -109,7 +109,7 @@ def _ring_pallas_fwd_impl(q, k, v, axis_name, causal, interpret):
     """Forward rotation loop; returns (o_f32, global lse)."""
     from ..ops.pallas_attention import _flash_fwd
 
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = lax.axis_index(axis_name)
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
 
@@ -158,7 +158,7 @@ def _ring_pallas_vjp_bwd(axis_name, causal, interpret, res, g):
     from ..ops.pallas_attention import _flash_bwd
 
     q, k, v, o, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = lax.axis_index(axis_name)
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
     g = g.astype(q.dtype)
@@ -223,7 +223,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     kernels per ring block (differentiable: streaming Pallas backward);
     ``'xla'`` is the jnp streaming-softmax path. Both support
     ``jax.grad``."""
-    from jax import shard_map
+    from .mesh import shard_map_compat as shard_map
 
     spec = P(None, None, axis_name, None)
 
@@ -267,7 +267,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
 
     Local shards: (B, H, T_local, D) with H divisible by the axis size.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     b, h, t_local, d = q.shape
     assert h % n == 0, f"heads {h} not divisible by seq-axis size {n}"
 
@@ -313,7 +313,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
 def ulysses_attention_sharded(q, k, v, mesh: Mesh,
                               axis_name: str = SEQ_AXIS,
                               causal: bool = False, impl: str = "xla"):
-    from jax import shard_map
+    from .mesh import shard_map_compat as shard_map
 
     if impl not in ("xla", "pallas"):
         raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")

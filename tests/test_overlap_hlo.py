@@ -1,0 +1,163 @@
+"""Comm/compute overlap evidence from the COMPILED artifact (VERDICT r5
+item 5): dump the optimized HLO of the >=2-device fused train step and
+assert the collective/compute structure the overlap claim rests on.
+
+PROFILE.md's round-5 bounds experiment proved zero overlap on this host
+and attributed it to the 1-core CPU (the Gloo collective IS host
+compute). The remaining unverified property was structural: does the
+compiled step put the gradient all-reduce INSIDE the one XLA module,
+adjacent to backward/optimizer compute, so the latency-hiding scheduler
+is free to hoist the async ``all-reduce-start``/``all-reduce-done`` pair
+apart on backends that have async collectives (TPU)? These tests turn
+that property into an inspectable artifact:
+
+* the CPU-mesh compile (this suite) asserts the all-reduce is fused into
+  the single train-step module with compute producers AND consumers —
+  the hoisting prerequisite (XLA's CPU backend emits the synchronous
+  all-reduce form; it never asyncifies);
+* :func:`assert_async_overlap` ALSO implements the TPU-form check —
+  matched start/done pairs with compute scheduled between them — and is
+  proven here against a captured TPU-style scheduled-HLO excerpt, so the
+  TPU tier run only needs to feed it the real dump
+  (``SPMDTrainer.step_hlo_text``).
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs a multi-device mesh")
+
+_COMPUTE_OP = re.compile(
+    r"=\s*\S+\s+(fusion|dot|convolution|multiply|add|subtract|tanh)\(")
+
+
+def assert_async_overlap(hlo: str) -> str:
+    """Assert the overlap-enabling collective structure of a scheduled
+    train-step HLO module; returns which form was found.
+
+    Async form (TPU): every ``all-reduce-start`` has a matching
+    ``all-reduce-done`` AND at least one compute instruction is scheduled
+    between them (the hoisted window the latency-hiding scheduler opened).
+    Sync form (CPU): plain ``all-reduce`` instructions coexist in the one
+    module with compute producers and consumers — the structural
+    prerequisite for the scheduler to hoist at all.
+    """
+    def defines(ln, op):
+        # the DEFINING instruction: op name on the lhs, before '='
+        return "=" in ln and op in ln.split("=", 1)[0]
+
+    lines = hlo.splitlines()
+    starts = [i for i, ln in enumerate(lines)
+              if defines(ln, "all-reduce-start")]
+    if starts:
+        for i in starts:
+            done = None
+            for j in range(i + 1, len(lines)):
+                if defines(lines[j], "all-reduce-done"):
+                    done = j
+                    break
+            assert done is not None, f"unmatched all-reduce-start: {lines[i]}"
+            between = [ln for ln in lines[i + 1:done]
+                       if _COMPUTE_OP.search(ln)
+                       and "all-reduce" not in ln]
+            assert between, (
+                "no compute scheduled between all-reduce-start and "
+                f"all-reduce-done (lines {i}-{done}) — the scheduler did "
+                "not hoist the pair apart")
+        return "async"
+    # sync form: collective fused into the same module as the compute
+    ar = [ln for ln in lines if re.search(r"all-reduce(\.\d+)?\s*=|="
+                                          r"\s*\S+\s+all-reduce\(", ln)]
+    assert ar, "no all-reduce instruction in the compiled train step"
+    compute = [ln for ln in lines if _COMPUTE_OP.search(ln)]
+    assert compute, "no compute instructions in the compiled train step"
+    # a consumer: some instruction takes an all-reduce result as operand
+    consumers = [ln for ln in lines
+                 if "all-reduce" in ln.split("=", 1)[-1]
+                 and "= " in ln and "all-reduce" not in ln.split("=")[0]]
+    assert consumers, "all-reduce result is never consumed by compute"
+    return "sync"
+
+
+def _small_trainer(n_dev=2):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=16, activation="relu"),
+            nn.Dense(4, in_units=32))
+    net.initialize(init="xavier")
+    net(mx.nd.zeros((2, 16)))
+    mesh = parallel.make_mesh({"data": n_dev},
+                              devices=jax.devices()[:n_dev])
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    rs = np.random.RandomState(0)
+    x = rs.rand(4 * n_dev, 16).astype(np.float32)
+    y = rs.randint(0, 4, (4 * n_dev,)).astype(np.float32)
+    return tr, x, y
+
+
+def test_fused_step_hlo_has_collective_inside_module():
+    """The 2-device fused train step compiles to ONE module containing
+    the gradient all-reduce(s) next to the backward/optimizer compute —
+    the property the per-tensor host-loop alternative would destroy."""
+    tr, x, y = _small_trainer(2)
+    hlo = tr.step_hlo_text(x, y)
+    assert hlo is not None, "backend exposed no compiled HLO"
+    form = assert_async_overlap(hlo)
+    # gradient all-reduce count: at least one per dense layer's dW chain
+    n_ar = len(re.findall(r"all-reduce", hlo))
+    assert n_ar >= 2, f"expected >=2 all-reduce mentions, got {n_ar}"
+    # the step still runs after the introspection compile
+    loss = float(jax.device_get(tr.step(x, y)))
+    assert np.isfinite(loss)
+    print(f"overlap form on {jax.default_backend()}: {form}, "
+          f"all-reduce mentions: {n_ar}")
+
+
+# A TPU-style scheduled-HLO excerpt (shape of the real artifact: async
+# pair hoisted apart with fusions scheduled in the window). Keeps the
+# async branch of assert_async_overlap proven on the CPU tier so the TPU
+# tier only has to feed it the real step_hlo_text dump.
+_TPU_STYLE_EXCERPT = """\
+ENTRY %main.42 (p0: f32[512,512], p1: f32[64,512]) -> f32[512,512] {
+  %p0 = f32[512,512]{1,0} parameter(0)
+  %p1 = f32[64,512]{1,0} parameter(1)
+  %dot.3 = f32[512,512]{1,0} dot(f32[64,512]{1,0} %p1, f32[64,512]{1,0} %p1)
+  %all-reduce-start.1 = f32[512,512]{1,0} all-reduce-start(f32[512,512]{1,0} %dot.3), channel_id=1, replica_groups=[1,2]<=[2], to_apply=%add.clone
+  %fusion.7 = f32[512,512]{1,0} fusion(f32[512,512]{1,0} %p0), kind=kLoop, calls=%fused_computation.7
+  %dot.4 = f32[512,512]{1,0} dot(f32[512,512]{1,0} %fusion.7, f32[512,512]{1,0} %p0)
+  %all-reduce-done.1 = f32[512,512]{1,0} all-reduce-done(f32[512,512]{1,0} %all-reduce-start.1)
+  ROOT %fusion.8 = f32[512,512]{1,0} fusion(f32[512,512]{1,0} %p0, f32[512,512]{1,0} %all-reduce-done.1, f32[512,512]{1,0} %dot.4), kind=kLoop, calls=%fused_computation.8
+}
+"""
+
+
+def test_async_pair_assertion_logic():
+    """The async-form branch: matched start/done with compute hoisted
+    between them passes; an empty window fails."""
+    assert assert_async_overlap(_TPU_STYLE_EXCERPT) == "async"
+    # collapse the window: move start directly before done
+    lines = _TPU_STYLE_EXCERPT.splitlines()
+    start = next(ln for ln in lines if "all-reduce-start" in ln)
+    squeezed = [ln for ln in lines if "all-reduce-start" not in ln]
+    done_at = next(i for i, ln in enumerate(squeezed)
+                   if "all-reduce-done" in ln)
+    squeezed.insert(done_at, start)
+    with pytest.raises(AssertionError):
+        assert_async_overlap("\n".join(squeezed))
+
+
+def test_sync_form_assertion_logic():
+    """The sync-form branch rejects a module with no all-reduce."""
+    with pytest.raises(AssertionError):
+        assert_async_overlap(
+            "ENTRY %m { %p = f32[2]{0} parameter(0)\n"
+            "ROOT %a = f32[2]{0} add(%p, %p) }")

@@ -21,6 +21,36 @@ def _run(args, timeout=900):  # generous: examples compile XLA programs and
                           text=True, timeout=timeout, env=env, cwd=REPO)
 
 
+def test_env_vars_doc_in_sync():
+    """docs/ENV_VARS.md is GENERATED from the config knob registry
+    (VERDICT r5 item 8 — the reference env_var.md analog); this test
+    fails whenever a knob is added/changed without regenerating:
+
+        python -c "from incubator_mxnet_tpu.config import write_env_vars_md; write_env_vars_md()"
+    """
+    from incubator_mxnet_tpu.config import generate_env_vars_md
+
+    path = os.path.join(REPO, "docs", "ENV_VARS.md")
+    assert os.path.exists(path), "docs/ENV_VARS.md missing — regenerate"
+    with open(path) as f:
+        committed = f.read()
+    assert committed == generate_env_vars_md(), (
+        "docs/ENV_VARS.md is stale — regenerate from the registry")
+
+
+def test_env_vars_doc_covers_new_kernel_knobs():
+    """The v2 Pallas conv knobs must be registered (and therefore
+    documented): the doc row exists and the knob resolves."""
+    from incubator_mxnet_tpu.config import config, generate_env_vars_md
+
+    md = generate_env_vars_md()
+    for name in ("MXTPU_CONV_OC_BLOCK", "MXTPU_CONV_ROW_TARGET",
+                 "MXTPU_CONV_VMEM_MB", "MXTPU_CONV_IM2COL",
+                 "MXTPU_CONV_BWD"):
+        assert f"| `{name}` |" in md, name
+        assert name in config._knobs
+
+
 def test_im2rec_list_and_pack_roundtrip(tmp_path):
     from PIL import Image
 
