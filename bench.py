@@ -149,7 +149,7 @@ def _fit_windows(window, n1=None, n2=None, k=None):
     scripts import it.
 
     Canonical MFU accounting (the one documented formula):
-        mfu_pct = 100 * (step_flops / median_per_step) / CEILING_TFS
+        mfu_pct = telemetry.mfu_percent(step_flops / median_per_step)
     with step_flops from XLA's own cost analysis and median_per_step from
     THIS function. BENCH json lines and the PROFILE.md tables must both
     cite it."""
@@ -172,8 +172,15 @@ def _fit_windows(window, n1=None, n2=None, k=None):
 
 # measured MXU ceiling: 187.9 TF/s via fence-free two-point-fit timing
 # of an 8192^3 bf16 matmul chain (PROFILE.md round 5 — the old 122.8
-# figure carried the fixed fence cost); nominal v5e ~197 TF/s bf16
-CEILING_TFS = float(os.environ.get("MXTPU_BENCH_CEILING_TFS", "187.9"))
+# figure carried the fixed fence cost); nominal v5e ~197 TF/s bf16.
+# Single source of truth (shared with the online mxtpu_mfu_percent
+# gauge): telemetry.ceiling_tfs reads MXTPU_BENCH_CEILING_TFS and
+# telemetry.mfu_percent is THE formula implementation — resolved lazily
+# so the driver loop never imports the package/jax.
+def _mfu_pct(tfs):
+    from incubator_mxnet_tpu.telemetry import mfu_percent
+
+    return mfu_percent(tfs * 1e12)
 
 
 def _tfs(trainer, args, per, n_dev):
@@ -395,6 +402,21 @@ CONFIGS = {
 ATTEMPTS = 3
 
 
+def _jsonl_emit(record):
+    """Mirror a bench row into the telemetry JSONL sink
+    (MXTPU_TELEMETRY_JSONL): one artifact carries the bench numbers AND
+    the per-step telemetry of the run that produced them, so
+    ``tools/telemetry_report.py --compare`` can diff two BENCH rounds
+    per metric. No-op when the sink is unconfigured; never lets
+    observability break the benchmark."""
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.jsonl_emit(record)
+    except Exception:
+        pass
+
+
 def run_one(key):
     """Run a single config in-process; print its JSON line to stdout."""
     fn = CONFIGS[key]
@@ -408,15 +430,17 @@ def run_one(key):
         }
         if tfs:
             line["tfs"] = round(tfs, 2)
-            line["mfu_pct"] = round(100.0 * tfs / CEILING_TFS, 1)
+            line["mfu_pct"] = round(_mfu_pct(tfs), 1)
         if LAST_FIT_STATS is not None:
             line["fit"] = LAST_FIT_STATS
+        _jsonl_emit({"kind": "bench", **line})
         print(json.dumps(line), flush=True)
         return 0
     except Exception as e:
-        print(json.dumps({
-            "metric": f"bench_{key}", "value": 0, "unit": "error",
-            "vs_baseline": 0, "error": str(e)[:200]}), flush=True)
+        err = {"metric": f"bench_{key}", "value": 0, "unit": "error",
+               "vs_baseline": 0, "error": str(e)[:200]}
+        _jsonl_emit({"kind": "bench", **err})
+        print(json.dumps(err), flush=True)
         return 1
 
 

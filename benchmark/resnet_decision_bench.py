@@ -267,6 +267,14 @@ def part_d():
     from incubator_mxnet_tpu.gluon.model_zoo import vision
     from incubator_mxnet_tpu.gluon.model_zoo.vision import fused_resnet
 
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.config import config
+
+    # the acceptance row for the ONLINE MFU gauge: force FLOP accounting
+    # on so the run_steps meter publishes mxtpu_mfu_percent, then print
+    # it next to the offline two-point-fit MFU — the two must agree
+    # within 15% (ISSUE 4) since they share the canonical formula
+    config.set("MXTPU_TELEMETRY_MFU", "1")
     batch = 128 * len(jax.devices())
     rs = np.random.RandomState(0)
     results = {}
@@ -287,8 +295,21 @@ def part_d():
                                        np.float32), sh)
         per = _steps_fit(tr, x, y)
         results[label] = per
+        flops = tr.step_cost_analysis(x, y)
+        offline_mfu = telemetry.mfu_percent(flops / per) if flops else None
+        gauge = telemetry.get_registry().find("mxtpu_mfu_percent",
+                                              site="spmd.run_steps")
+        online_mfu = gauge.value if gauge is not None and gauge.value \
+            else None
+        mfu_txt = ""
+        if offline_mfu is not None:
+            mfu_txt = f"  offline MFU {offline_mfu:.1f}%"
+            if online_mfu is not None:
+                rel = abs(online_mfu - offline_mfu) / offline_mfu * 100
+                mfu_txt += (f"  online gauge {online_mfu:.1f}% "
+                            f"(|delta| {rel:.0f}%)")
         print(f"{label:5s} train step: {per * 1e3:.1f} ms/step "
-              f"{batch / per:.0f} img/s", flush=True)
+              f"{batch / per:.0f} img/s{mfu_txt}", flush=True)
         del tr, x, y, net
     ratio = results["zoo"] / results["fused"]
     verdict = "PRIZE CLAIMED" if ratio >= 0.95 else "still behind"

@@ -130,6 +130,27 @@ def main():
           f"occupancy {stats['batch_occupancy']:.1f}   "
           f"compiles {stats['executor_cache']['compiles']}")
 
+    # mirror the run into the telemetry JSONL sink (MXTPU_TELEMETRY_JSONL)
+    # so tools/telemetry_report.py --compare can diff serving rounds;
+    # never let observability break the benchmark
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        for metric, value, unit in (
+                ("serving_unbatched_rps", n / uw, "req/s"),
+                ("serving_batched_rps", n / sw, "req/s"),
+                ("serving_batched_p50_ms", pctl(sl, 50) * 1e3, "ms"),
+                ("serving_batched_p99_ms", pctl(sl, 99) * 1e3, "ms"),
+                ("serving_batch_occupancy", stats["batch_occupancy"],
+                 "req"),
+                ("serving_compiles", stats["executor_cache"]["compiles"],
+                 "count")):
+            telemetry.jsonl_emit({"kind": "bench", "metric": metric,
+                                  "value": round(float(value), 3),
+                                  "unit": unit})
+    except Exception:
+        pass
+
 
 if __name__ == "__main__":
     main()

@@ -190,6 +190,44 @@ config.register(
     "tier; 'pallas' forces every shape through the Pallas kernels; "
     "'xla' restores the round-4 vjp-over-XLA backward.")
 config.register(
+    "MXTPU_TELEMETRY", True, _parse_bool,
+    "Master switch for mxtpu.telemetry (docs/OBSERVABILITY.md): the "
+    "metrics registry, step meters, and recompile watchdog. Off (0), "
+    "every instrument is the shared no-op NULL and the hot paths skip "
+    "their metering scopes — measured within noise of the "
+    "uninstrumented step.")
+config.register(
+    "MXTPU_METRICS_PORT", 0, int,
+    "Port for the Prometheus /metrics pull exporter (stdlib http.server "
+    "daemon thread). 0 (default) disables the server; it can also be "
+    "started programmatically via telemetry.serve_metrics().")
+config.register(
+    "MXTPU_METRICS_HOST", "127.0.0.1", str,
+    "Bind address for the /metrics exporter. Loopback by default — the "
+    "endpoint is unauthenticated; set 0.0.0.0 to expose it beyond the "
+    "host deliberately.")
+config.register(
+    "MXTPU_TELEMETRY_JSONL", "", str,
+    "Path of the JSON-lines telemetry sink: one object per step / "
+    "recompile / bench row. Summarize or diff runs with "
+    "tools/telemetry_report.py. Empty (default) disables the sink.")
+config.register(
+    "MXTPU_RECOMPILE_WARMUP_STEPS", 10, int,
+    "Per-site step budget before the recompile watchdog starts flagging "
+    "XLA compiles. Compiles within the first N steps of a site "
+    "(trainer/SPMD/pipeline step, serving batch) are expected warmup; a "
+    "compile after that means a cache key is drifting and is recorded, "
+    "counted (mxtpu_recompiles_flagged_total) and logged with the "
+    "triggering site.")
+config.register(
+    "MXTPU_TELEMETRY_MFU", "auto", str,
+    "Online MFU accounting (mxtpu_mfu_percent gauge). 'auto' (default) "
+    "computes XLA cost-analysis FLOPs only while a JSONL sink or "
+    "/metrics server is live, because deriving FLOPs costs one extra "
+    "AOT compile per executable signature; '1'/'0' force it on/off. "
+    "The gauge uses bench.py's canonical formula against the measured "
+    "ceiling (MXTPU_BENCH_CEILING_TFS).")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .. import optimizer as opt_mod
 from .. import profiler
+from .. import telemetry
 from ..kvstore import KVStore
 from ..kvstore import create as kv_create
 from ..ndarray import NDArray
@@ -66,6 +67,8 @@ class FusedStep:
         self._trainer = trainer
         self._cache: Dict[tuple, object] = {}
         self._zeros_cache: Dict[tuple, jax.Array] = {}
+        self._flops: Dict[tuple, Optional[float]] = {}
+        self.last_flops: Optional[float] = None
         self.shard_update = False
         # set by Trainer.step when the cross-process allreduce should fuse
         # into the executable; consumed (and cleared) by run()
@@ -114,6 +117,11 @@ class FusedStep:
     def _run(self, tr: "Trainer", ingraph: bool,
              ignore_stale_grad: bool) -> bool:
         from ..ndarray.sparse import RowSparseNDArray
+
+        # only a fused run that actually executes sets this; a fallback
+        # must not leave fused-executable FLOPs paired with per-param
+        # wall time in the MFU gauge
+        self.last_flops = None
 
         upd = tr._updater
         opt = upd.optimizer
@@ -205,6 +213,8 @@ class FusedStep:
                      getattr(compressor, "threshold", None), shard)
         jfn = self._cache.get(cache_key)
         if jfn is None:
+            telemetry.note_cache_miss("trainer.step",
+                                      detail=f"fused:{type(opt).__name__}")
             jfn = self._build(opt, len(mine), reduce_fn, multiproc)
             self._cache[cache_key] = jfn
 
@@ -231,6 +241,13 @@ class FusedStep:
             from .. import random as _random
 
             args.append(_rep(_random.next_key()))
+        if telemetry.mfu_enabled():
+            # computed BEFORE the call (weights/states are donated) and
+            # once per executable signature — AOT lower+compile is how
+            # XLA's cost model is reached from a jit fn
+            if cache_key not in self._flops:
+                self._flops[cache_key] = telemetry.aot_flops(jfn, args)
+            self.last_flops = self._flops[cache_key]
         with profiler.scope("gluon.fused_step"):
             new_ws, new_states = jfn(*args)
         self.dispatch_count += 1
@@ -334,6 +351,9 @@ class Trainer:
         self._distributed = False
         self._fused = FusedStep(self)
         self._fused_mode = True      # auto: fuse whenever possible
+        self._telemetry = telemetry.StepMeter("trainer.step")
+        self._last_perparam_updates = 0
+        telemetry.maybe_start_http()
 
     # -- kvstore ------------------------------------------------------------
     def _init_kvstore(self):
@@ -392,8 +412,15 @@ class Trainer:
             # update-on-kvstore pushes reduce server-side; a prior
             # allreduce would double-count
             self._allreduce_grads()
+        d0 = self._fused.dispatch_count
         try:
-            self._update(ignore_stale_grad)
+            with self._telemetry.step(
+                    flops_fn=lambda: self._fused.last_flops) as sc:
+                self._update(ignore_stale_grad)
+                if sc is not None:
+                    fused_d = self._fused.dispatch_count - d0
+                    sc.dispatches = fused_d if fused_d \
+                        else max(1, self._last_perparam_updates)
         finally:
             self._fused.pending_allreduce = False
 
@@ -429,8 +456,13 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False):
+        self._last_perparam_updates = 0
         if self._fused_mode and self._fused.run(ignore_stale_grad):
             return
+        # per-param path (fused off, or run() fell back): fused-executable
+        # FLOPs must not be paired with per-param wall time in the MFU
+        # gauge
+        self._fused.last_flops = None
         kv_batch = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
@@ -449,6 +481,7 @@ class Trainer:
             else:
                 p._data._grad_fresh = False
                 self._updater(i, p.grad(), p.data())
+                self._last_perparam_updates += 1
         if kv_batch:
             # one batched fused-collective call instead of per-parameter
             # push/pull pairs (the updater on the kvstore applies the rule)

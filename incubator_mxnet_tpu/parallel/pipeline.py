@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry
 from ..ndarray import NDArray
 
 from .mesh import (DATA_AXIS, PIPE_AXIS, make_mesh, mesh_scope,
@@ -454,6 +455,9 @@ class PipelineTrainer:
         self.tx = _to_optax(optimizer, optimizer_params)
         self._donate = donate
         self._step_cache: Dict[Any, Callable] = {}
+        self._telemetry = telemetry.StepMeter("pipeline.step")
+        self._flops_cache: Dict[Any, Any] = {}
+        telemetry.maybe_start_http()
 
         self._stage_objs = collect_params(self.stages[0])
         for i, st in enumerate(self.stages[1:], 1):
@@ -663,16 +667,32 @@ class PipelineTrainer:
         y = jax.device_put(y, self._batch_sharding)
         key = (x.shape, str(x.dtype), y.shape, str(y.dtype))
         fn = self._step_cache.get(key)
-        if fn is None:
+        miss = fn is None
+        if miss:
             fn = self._build_step()
             self._step_cache[key] = fn
         rng = _random.next_key()
+        if telemetry.mfu_enabled() and key not in self._flops_cache:
+            # once per signature, BEFORE the call (params are donated)
+            with mesh_scope(self.mesh):
+                self._flops_cache[key] = telemetry.aot_flops(
+                    fn, (self.params, self.frozen, self.opt_state, rng,
+                         x, y))
         # trace/execute under the ambient-mesh scope so mesh-aware ops in
         # prologue/epilogue (e.g. moe_ffn) see self.mesh (same as
         # SPMDTrainer.step)
-        with mesh_scope(self.mesh):
-            self.params, self.frozen, self.opt_state, loss = fn(
-                self.params, self.frozen, self.opt_state, rng, x, y)
+        with self._telemetry.step(
+                h2d_bytes=int(x.nbytes) + int(y.nbytes),
+                flops_fn=lambda: self._flops_cache.get(key)):
+            if miss:
+                # jax.monitoring-less fallback; inside the meter scope
+                # so the tick marks this step compile-dominated like a
+                # real compile event would
+                telemetry.note_cache_miss("pipeline.step",
+                                          detail=str(x.shape))
+            with mesh_scope(self.mesh):
+                self.params, self.frozen, self.opt_state, loss = fn(
+                    self.params, self.frozen, self.opt_state, rng, x, y)
         return loss
 
     def sync_to_net(self) -> None:

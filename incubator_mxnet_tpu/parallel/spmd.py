@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry
 from ..gluon.parameter import Parameter, _trace
 from ..gluon.block import _Trace
 from ..ndarray import NDArray
@@ -148,6 +149,10 @@ class SPMDTrainer:
         self._step_cache: Dict[Any, Callable] = {}
         self._num_steps = 0
         self._donate = donate
+        self._telemetry = telemetry.StepMeter("spmd.step")
+        self._loop_telemetry = telemetry.StepMeter("spmd.run_steps")
+        self._flops_cache: Dict[Any, Optional[float]] = {}
+        telemetry.maybe_start_http()
 
         self._param_objs = collect_params(net)
         self._trainable = {n: p for n, p in self._param_objs.items()
@@ -283,7 +288,8 @@ class SPMDTrainer:
         key = (tuple((a.shape, str(a.dtype)) for a in data_arrays),
                tuple((a.shape, str(a.dtype)) for a in label_arrays))
         fn = self._step_cache.get(key)
-        if fn is None:
+        miss = fn is None
+        if miss:
             fn = self._jit_step(len(data_arrays), len(label_arrays))
             self._step_cache[key] = fn
         self._num_steps += 1
@@ -292,11 +298,30 @@ class SPMDTrainer:
         # (e.g. moe_ffn's expert-axis sharding constraint) see self.mesh
         from .mesh import mesh_scope
 
-        with mesh_scope(self.mesh):
-            self.params, self.frozen, self.opt_state, loss = fn(
-                self.params, self.frozen, self.opt_state, rng, data_arrays,
-                label_arrays)
+        h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
+        with self._telemetry.step(
+                h2d_bytes=h2d,
+                flops_fn=lambda: self._flops_for(key, data, labels)):
+            if miss:
+                # jax.monitoring-less fallback: the ragged-batch
+                # recompile this cache miss implies must still be seen.
+                # Inside the meter scope, so its site_compiles tick
+                # marks this step compile-dominated (EMA/MFU exclusion)
+                # just like a real compile event would.
+                telemetry.note_cache_miss("spmd.step", detail=str(key[0]))
+            with mesh_scope(self.mesh):
+                self.params, self.frozen, self.opt_state, loss = fn(
+                    self.params, self.frozen, self.opt_state, rng,
+                    data_arrays, label_arrays)
         return loss
+
+    def _flops_for(self, key, data, labels) -> Optional[float]:
+        """Per-step cost-analysis FLOPs, computed once per step-cache
+        signature (an extra AOT compile) and only when the telemetry MFU
+        gauge is observed."""
+        if key not in self._flops_cache:
+            self._flops_cache[key] = self.step_cost_analysis(data, labels)
+        return self._flops_cache[key]
 
     def _compile_step(self, data, labels):
         """Lower + compile the fused step for introspection (cost
@@ -313,7 +338,9 @@ class SPMDTrainer:
         from .mesh import mesh_scope
 
         try:
-            with mesh_scope(self.mesh):
+            # deliberate introspection compile (MFU probe / HLO dump):
+            # probe_scope keeps it off the watchdog's drift radar
+            with telemetry.probe_scope(), mesh_scope(self.mesh):
                 return fn.lower(
                     self.params, self.frozen, self.opt_state,
                     jax.random.PRNGKey(0), data_arrays,
@@ -327,17 +354,7 @@ class SPMDTrainer:
         fwd+bwd) or ``None`` where the PJRT backend doesn't expose cost
         analysis. Used by ``bench.py`` for MFU accounting — one source of
         truth instead of hand-maintained per-model FLOP formulas."""
-        compiled = self._compile_step(data, labels)
-        if compiled is None:
-            return None
-        try:
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):   # one dict per device
-                cost = cost[0] if cost else {}
-            flops = float(cost.get("flops", 0.0)) if cost else 0.0
-            return flops or None
-        except Exception:
-            return None
+        return telemetry.flops_of_compiled(self._compile_step(data, labels))
 
     def step_hlo_text(self, data, labels) -> Optional[str]:
         """Post-optimization HLO of the compiled fused train-step
@@ -381,7 +398,8 @@ class SPMDTrainer:
                tuple((a.shape, str(a.dtype)) for a in data_arrays),
                tuple((a.shape, str(a.dtype)) for a in label_arrays))
         fn = self._step_cache.get(key)
-        if fn is None:
+        miss = fn is None
+        if miss:
             raw = self._build_step(len(data_arrays), len(label_arrays))
 
             def loop(train_p, frozen_p, opt_state, rng, data_arrays,
@@ -402,10 +420,21 @@ class SPMDTrainer:
         rng = _random.next_key()
         from .mesh import mesh_scope
 
-        with mesh_scope(self.mesh):
-            self.params, self.frozen, self.opt_state, loss = fn(
-                self.params, self.frozen, self.opt_state, rng,
-                data_arrays, label_arrays)
+        # MFU for the loop uses the SINGLE-step executable's flops (the
+        # loop body is the step body; per-step wall time is dt/n)
+        skey = (tuple((a.shape, str(a.dtype)) for a in data_arrays),
+                tuple((a.shape, str(a.dtype)) for a in label_arrays))
+        h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
+        with self._loop_telemetry.step(
+                h2d_bytes=h2d, count=n,
+                flops_fn=lambda: self._flops_for(skey, data, labels)):
+            if miss:
+                # fallback miss inside the scope: see step()
+                telemetry.note_cache_miss("spmd.run_steps", detail=f"n={n}")
+            with mesh_scope(self.mesh):
+                self.params, self.frozen, self.opt_state, loss = fn(
+                    self.params, self.frozen, self.opt_state, rng,
+                    data_arrays, label_arrays)
         return loss
 
     def sync_to_net(self) -> None:

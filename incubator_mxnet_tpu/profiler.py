@@ -89,18 +89,32 @@ def _record(name, cat, ph, ts=None, dur=None, args=None):
         _state["records"].append(ev)
 
 
-def dump(finished: bool = True, profile_process: str = "worker"):
-    """Write the chrome://tracing JSON (reference ``profiler.dump``)."""
-    fname = _state["config"].get("filename", "profile.json")
+def dump(finished: bool = True, profile_process: str = "worker",
+         filename: Optional[str] = None):
+    """Write the chrome://tracing JSON (reference ``profiler.dump``).
+
+    The target path is resolved HERE, not at ``set_state('run')`` time,
+    so ``set_config(filename=...)`` issued while the profiler is already
+    running is honored (regression: config used to matter only at
+    start). The XPlane trace directory was fixed at start; its path is
+    recorded in the trace's ``otherData`` so tooling can still correlate
+    the two artifacts after a mid-run rename."""
+    fname = filename or _state["config"].get("filename", "profile.json")
+    payload = {"traceEvents": _state["records"], "displayTimeUnit": "ms"}
+    if _state["jax_trace_dir"] is not None:
+        payload["otherData"] = {"xplane_dir": _state["jax_trace_dir"]}
     with open(fname, "w") as f:
-        json.dump({"traceEvents": _state["records"],
-                   "displayTimeUnit": "ms"}, f)
+        json.dump(payload, f)
     return fname
 
 
 def dumps(reset: bool = False) -> str:
     """Aggregate per-scope stats table (reference
-    ``MXAggregateProfileStatsPrint``)."""
+    ``MXAggregateProfileStatsPrint``) plus the live counter values.
+
+    ``reset=True`` clears the scope records AND zeroes every counter
+    (regression fix: counters used to survive a reset, so the next
+    window's table started from stale values)."""
     agg: Dict[str, List[float]] = {}
     for ev in _state["records"]:
         if ev.get("ph") == "X":
@@ -112,8 +126,17 @@ def dumps(reset: bool = False) -> str:
         total = sum(durs) / 1e3
         lines.append(f"{name:40s} {len(durs):8d} {total:12.3f} "
                      f"{total / len(durs):10.3f} {max(durs) / 1e3:10.3f}")
+    with _state["lock"]:
+        counters = dict(_state["counters"])
+    if counters:
+        lines.append("")
+        lines.append(f"{'Counter':40s} {'Value':>12s}")
+        for name in sorted(counters):
+            lines.append(f"{name:40s} {counters[name]._value:12g}")
     if reset:
         _state["records"] = []
+        for c in counters.values():
+            c.reset()       # registration survives; values restart at 0
     return "\n".join(lines)
 
 
@@ -181,14 +204,27 @@ class Event(_Scope):
 
 
 class Counter:
+    """Profiler counter track, now backed by the shared telemetry
+    registry: every value lands in a ``mxtpu.telemetry`` gauge under the
+    counter's own name (slashes sanitized at Prometheus exposition), so
+    profiler counters and telemetry metrics are ONE namespace served by
+    one exporter — while the chrome-trace 'C' events keep flowing when a
+    profiling run is active."""
+
     def __init__(self, domain, name, value=None):
         self.name = name
         self._value = value or 0
+        from . import telemetry
+
+        self._gauge = telemetry.gauge(name)
+        with _state["lock"]:
+            _state["counters"][name] = self
         if value is not None:
             self.set_value(value)
 
     def set_value(self, value):
         self._value = value
+        self._gauge.set(value)
         if _state["running"]:
             _record(self.name, "counter", "C",
                     args={"value": value})
@@ -198,6 +234,12 @@ class Counter:
 
     def decrement(self, delta=1):
         self.set_value(self._value - delta)
+
+    def reset(self):
+        """Zero the counter (``dumps(reset=True)``) without emitting a
+        trace event."""
+        self._value = 0
+        self._gauge.set(0)
 
 
 class Marker:
@@ -214,9 +256,25 @@ def scope(name: str):
     return Event(name)
 
 
+#: serializes counter() get-or-create (Counter.__init__ takes
+#: _state["lock"] itself, so the check-then-create needs its own guard
+#: to be atomic)
+_counter_guard = threading.Lock()
+
+
 def counter(name: str, value=None) -> Counter:
-    """Standalone named counter (no Domain). The serving subsystem
+    """Standalone named counter (no Domain), get-or-create by name: two
+    callers of the same name (two serving replicas of one model) share
+    one instance, so ``dumps()``'s counter table and
+    ``dumps(reset=True)`` see every writer. The serving subsystem
     publishes queue depth and batch occupancy through this so they show
     up as counter tracks in the chrome trace next to its execution
     scopes."""
-    return Counter(None, name, value)
+    with _counter_guard:
+        with _state["lock"]:
+            existing = _state["counters"].get(name)
+        if existing is None:
+            return Counter(None, name, value)
+    if value is not None:
+        existing.set_value(value)
+    return existing

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import profiler
+from .. import telemetry
 from .metrics import ServingMetrics
 
 # powers of two up to a modest ceiling: small buckets keep padding waste
@@ -148,8 +149,12 @@ class BucketedExecutorCache:
                 self.metrics.cache_hit()
                 return ex
             self.metrics.cache_miss()
+            telemetry.note_cache_miss(f"serving.{self.name}",
+                                      detail=f"bucket={bucket}")
             t0 = time.perf_counter()
-            with profiler.scope(f"serving::{self.name}::compile"):
+            with telemetry.attribute(f"serving.{self.name}",
+                                     detail=f"bucket={bucket}"), \
+                    profiler.scope(f"serving::{self.name}::compile"):
                 jitted = jax.jit(
                     self._apply,
                     donate_argnums=(1,) if self._donate else ())

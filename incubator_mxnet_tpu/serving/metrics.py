@@ -6,6 +6,15 @@ request latency percentiles (sliding window), queue depth, batch
 occupancy (requests per executed batch — the number dynamic batching
 exists to raise), and executor-cache hit/miss/compile counters.
 
+Every observation is mirrored into the shared ``mxtpu.telemetry``
+registry (``mxtpu_serving_*`` metric families, labelled by model), so
+serving and training counters live in ONE namespace behind ONE set of
+exporters (Prometheus /metrics, JSONL — docs/OBSERVABILITY.md) instead
+of the pre-telemetry split-brain of serving-local dicts vs profiler
+counters. The local ints stay authoritative for ``snapshot()`` — they
+are functional server state (backpressure, occupancy) and must work
+with telemetry disabled.
+
 The live gauges are also published through ``profiler.counter`` so a
 profiling run (``profiler.set_state('run')``) shows queue depth and
 batch size as counter tracks in the chrome trace, next to the
@@ -20,6 +29,10 @@ from collections import deque
 from typing import Dict, Optional
 
 from .. import profiler
+from .. import telemetry
+
+#: occupancy bucket bounds: requests per executed batch
+_OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def _percentile(sorted_vals, p: float) -> float:
@@ -49,41 +62,82 @@ class ServingMetrics:
         self.queue_depth = 0
         self._c_depth = profiler.counter(f"serving/{model}/queue_depth")
         self._c_batch = profiler.counter(f"serving/{model}/batch_size")
+        # shared-registry mirrors (no-op NULL instruments when telemetry
+        # is disabled)
+        lbl = {"model": model}
+        self._t_requests = telemetry.counter(
+            "mxtpu_serving_requests_total", "requests answered", **lbl)
+        self._t_rejected = telemetry.counter(
+            "mxtpu_serving_rejected_total",
+            "requests rejected by backpressure", **lbl)
+        self._t_batches = telemetry.counter(
+            "mxtpu_serving_batches_total", "batches executed", **lbl)
+        self._t_queue = telemetry.gauge(
+            "mxtpu_serving_queue_depth", "requests waiting", **lbl)
+        self._t_occupancy = telemetry.histogram(
+            "mxtpu_serving_batch_occupancy",
+            "requests per executed batch",
+            buckets=_OCCUPANCY_BUCKETS, **lbl)
+        self._t_latency = telemetry.histogram(
+            "mxtpu_serving_request_latency_seconds",
+            "submit-to-result request latency", **lbl)
+        self._t_hits = telemetry.counter(
+            "mxtpu_serving_cache_hits_total",
+            "executor-cache hits", **lbl)
+        self._t_misses = telemetry.counter(
+            "mxtpu_serving_cache_misses_total",
+            "executor-cache misses", **lbl)
+        self._t_compiles = telemetry.counter(
+            "mxtpu_serving_compiles_total",
+            "executor compiles", **lbl)
+        self._t_compile_s = telemetry.counter(
+            "mxtpu_serving_compile_seconds_total",
+            "time spent compiling executors", **lbl)
 
     # -- batcher-side observations -------------------------------------------
     def observe_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth = depth
         self._c_depth.set_value(depth)
+        self._t_queue.set(depth)
 
     def observe_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        self._t_rejected.inc()
 
     def observe_batch(self, batch_size: int) -> None:
         with self._lock:
             self.batches += 1
             self._batch_sizes.append(batch_size)
         self._c_batch.set_value(batch_size)
+        self._t_batches.inc()
+        self._t_occupancy.observe(batch_size)
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             self.requests += 1
             self._latencies.append(seconds)
+        self._t_requests.inc()
+        self._t_latency.observe(seconds)
 
     # -- executor-cache-side observations ------------------------------------
     def cache_hit(self) -> None:
         with self._lock:
             self.cache_hits += 1
+        self._t_hits.inc()
 
     def cache_miss(self) -> None:
         with self._lock:
             self.cache_misses += 1
+        self._t_misses.inc()
 
     def observe_compile(self, seconds: float) -> None:
         with self._lock:
             self.compiles += 1
             self.compile_seconds += seconds
+        self._t_compiles.inc()
+        self._t_compile_s.inc(seconds)
 
     # -- reads ----------------------------------------------------------------
     def latency_ms(self, p: float) -> float:

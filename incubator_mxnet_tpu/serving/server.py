@@ -31,6 +31,7 @@ from typing import Any, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import profiler
+from .. import telemetry
 from .batcher import DynamicBatcher, QueueFullError, ServerClosedError
 from .executor_cache import DEFAULT_BUCKETS, BucketedExecutorCache
 from .metrics import ServingMetrics
@@ -77,6 +78,8 @@ class ModelServer:
             self._run_batch, max_batch_size=max_batch_size,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             metrics=self.metrics, name=name)
+        self._meter = telemetry.StepMeter(f"serving.{name}")
+        telemetry.maybe_start_http()
 
     # -- construction from artifacts -----------------------------------------
     @classmethod
@@ -131,8 +134,12 @@ class ModelServer:
 
     # -- dispatch -------------------------------------------------------------
     def _run_batch(self, batch: np.ndarray):
-        with profiler.scope(f"serving::{self.name}::batch"):
-            out = self._cache(batch)
+        # one telemetry step per executed batch: wall time, request
+        # bytes moved H2D, recompile attribution to this model's site
+        with self._meter.step(h2d_bytes=int(batch.nbytes),
+                              detail=f"batch={batch.shape[0]}"):
+            with profiler.scope(f"serving::{self.name}::batch"):
+                out = self._cache(batch)
         if isinstance(out, tuple):
             return tuple(np.asarray(o) for o in out)
         return np.asarray(out)

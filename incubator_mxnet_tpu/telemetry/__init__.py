@@ -1,0 +1,315 @@
+"""``mxtpu.telemetry`` — unified step-level runtime telemetry.
+
+The observability layer (docs/OBSERVABILITY.md): a typed metrics
+registry (Counter / Gauge / Histogram) shared by every subsystem, three
+built-in meters wired into the hot paths (recompile watchdog, step
+telemetry, online MFU/memory), and exporters (Prometheus /metrics,
+JSONL file sink, chrome-trace correlation into ``mx.profiler``).
+
+The reference stack is operated through MXNet's profiler + monitor +
+KVStore server stats (SURVEY.md §5); TF's system paper
+(arXiv:1605.08695) states the principle this package implements: a
+training/serving system at scale is operated through its metrics.
+
+Quick start::
+
+    import incubator_mxnet_tpu as mx
+
+    # metrics are on by default; export them:
+    #   MXTPU_METRICS_PORT=9100      -> GET :9100/metrics (Prometheus)
+    #   MXTPU_TELEMETRY_JSONL=run.jsonl -> one JSON object per step
+    # then train/serve as usual; summarize with
+    #   python tools/telemetry_report.py run.jsonl
+
+    from incubator_mxnet_tpu import telemetry
+    telemetry.get_watchdog().flagged()   # post-warmup recompiles, if any
+
+Disable with ``MXTPU_TELEMETRY=0``: every instrument the package hands
+out becomes the shared no-op ``NULL`` and the hot paths skip their
+metering scopes entirely (measured: within noise of the uninstrumented
+step).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Dict, Optional
+
+from .registry import (NULL, Counter, DEFAULT_TIME_BUCKETS, Gauge,
+                       Histogram, MetricsRegistry, NullInstrument,
+                       get_registry)
+from .exporters import (JSONLSink, MetricsHTTPServer, prometheus_text,
+                        read_jsonl, sanitize_metric_name)
+from .meters import (StepMeter, aot_flops, ceiling_tfs, mfu_percent,
+                     device_memory_stats, flops_of_compiled)
+from .watchdog import (COMPILE_EVENTS, RecompileEvent, RecompileWatchdog,
+                       attribute, current_attribution, probe_scope)
+
+__all__ = [
+    "COMPILE_EVENTS", "Counter", "DEFAULT_TIME_BUCKETS", "Gauge",
+    "Histogram", "JSONLSink", "MetricsHTTPServer", "MetricsRegistry",
+    "NULL", "NullInstrument", "RecompileEvent", "RecompileWatchdog",
+    "StepMeter", "aot_flops", "attribute", "ceiling_tfs", "counter",
+    "current_attribution", "device_memory_stats", "enabled",
+    "flops_of_compiled", "gauge", "get_registry", "get_watchdog",
+    "histogram", "jsonl_emit", "jsonl_sink", "maybe_start_http",
+    "mfu_enabled", "mfu_percent", "note_cache_miss", "probe_scope",
+    "prometheus_text", "read_jsonl", "reset",
+    "sanitize_metric_name", "set_jsonl", "serve_metrics",
+]
+
+_lock = threading.Lock()
+_watchdog: Optional[RecompileWatchdog] = None
+_jsonl: Optional[JSONLSink] = None
+_jsonl_cfg: Optional[str] = None  # config value the sink currently reflects
+_jsonl_pinned = False  # set_jsonl() took ownership; stop following config
+_http: Optional[MetricsHTTPServer] = None
+_http_failed_port: Optional[int] = None
+
+
+def enabled() -> bool:
+    """Is telemetry on? (``MXTPU_TELEMETRY``, default on; runtime
+    override via ``config.set``.)
+
+    Contract: step meters consult this per step, but *instruments* bind
+    at creation — a counter/gauge handed out while disabled is the
+    no-op ``NULL`` for its lifetime (that is what makes the disabled
+    path allocation-free). Toggling at runtime therefore affects meters
+    and newly created instruments; objects that cached instruments
+    while disabled (a ``ServingMetrics``, a ``profiler.Counter``) must
+    be recreated to start reporting."""
+    from ..config import config
+
+    return bool(config.get("MXTPU_TELEMETRY"))
+
+
+def mfu_enabled() -> bool:
+    """Is online MFU accounting on? ``MXTPU_TELEMETRY_MFU``: ``auto``
+    (default) computes FLOPs only while someone observes — a JSONL sink
+    or /metrics server is live — because deriving FLOPs costs one extra
+    AOT compile per executable signature; ``1``/``0`` force it."""
+    from ..config import config
+
+    if not enabled():
+        return False
+    val = str(config.get("MXTPU_TELEMETRY_MFU")).strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return jsonl_sink() is not None or _http is not None
+
+
+# -- instrument front door (zero-cost when disabled) ------------------------
+def counter(name: str, help: str = "", **labels):
+    """Registry counter, or the shared no-op when disabled."""
+    if not enabled():
+        return NULL
+    return get_registry().counter(name, help, **labels)
+
+
+def gauge(name: str, help: str = "", **labels):
+    if not enabled():
+        return NULL
+    return get_registry().gauge(name, help, **labels)
+
+
+def histogram(name: str, help: str = "", buckets=None, **labels):
+    if not enabled():
+        return NULL
+    return get_registry().histogram(name, help, buckets=buckets, **labels)
+
+
+def _instruments_for_compile(site: Optional[str]):
+    """(compiles_total, recompiles_flagged_total) for the watchdog."""
+    s = {"site": site if site else "(unattributed)"}
+    return (counter("mxtpu_compiles_total",
+                    "XLA backend compiles observed", **s),
+            counter("mxtpu_recompiles_flagged_total",
+                    "post-warmup recompiles flagged by the watchdog",
+                    **s))
+
+
+# -- global watchdog --------------------------------------------------------
+def get_watchdog() -> Optional[RecompileWatchdog]:
+    """The process-global recompile watchdog, armed on first use while
+    telemetry is enabled; None when disabled."""
+    global _watchdog
+    if not enabled():
+        return None
+    # lock-free fast path: every StepMeter scope lands here twice per
+    # step (enter + commit); assignment below is atomic, so the armed
+    # case must not contend on the process-global lock
+    wd = _watchdog
+    if wd is not None:
+        return wd
+    with _lock:
+        if _watchdog is None:
+            _watchdog = RecompileWatchdog().start()
+        return _watchdog
+
+
+def note_cache_miss(site: str, detail: str = "") -> None:
+    """Engine-managed executable-cache miss (FusedStep rebuild, serving
+    executor-cache miss, SPMD/pipeline jit-dict miss): the
+    jax.monitoring-less fallback signal for the recompile watchdog. A
+    no-op when telemetry is disabled or the compile-event listener is
+    installed (the listener already saw the compile)."""
+    wd = get_watchdog()
+    if wd is not None:
+        wd.note_cache_miss(site, detail=detail)
+
+
+# -- JSONL sink -------------------------------------------------------------
+def jsonl_sink() -> Optional[JSONLSink]:
+    """The configured JSONL sink (``MXTPU_TELEMETRY_JSONL`` or
+    :func:`set_jsonl`), or None. Follows the config knob: a
+    ``config.set('MXTPU_TELEMETRY_JSONL', path)`` at any point — even
+    after steps have already run — opens/retargets the sink on the next
+    emit, until :func:`set_jsonl` pins it explicitly."""
+    global _jsonl, _jsonl_cfg
+    if _jsonl_pinned:
+        return _jsonl
+    from ..config import config
+
+    path = str(config.get("MXTPU_TELEMETRY_JSONL") or "").strip()
+    if not path and _jsonl is None and not _jsonl_cfg:
+        # fast path: nothing configured, nothing open — every step
+        # commit lands here in the common unconfigured case, so skip
+        # the process-global lock entirely (benign race: a concurrent
+        # configure is simply picked up on the next call)
+        return None
+    with _lock:
+        if _jsonl_pinned:
+            return _jsonl
+        if path != _jsonl_cfg:
+            _jsonl_cfg = path
+            if _jsonl is not None:
+                _jsonl.close()
+                _jsonl = None
+            if path:
+                try:
+                    _jsonl = JSONLSink(path)
+                    atexit.register(_jsonl.close)
+                except OSError as e:
+                    # observability must never break the run, but a lost
+                    # sink must not be silent: warn once per configured
+                    # path (a retarget retries, like /metrics)
+                    _jsonl = None
+                    import logging
+
+                    logging.getLogger("mxtpu.telemetry").warning(
+                        "telemetry JSONL sink not opened at %s: %s",
+                        path, e)
+        return _jsonl
+
+
+def set_jsonl(path: Optional[str]) -> Optional[JSONLSink]:
+    """Point the JSONL sink at ``path`` (None closes it). Pins the
+    sink: later config/env changes no longer retarget it."""
+    global _jsonl, _jsonl_pinned
+    with _lock:
+        if _jsonl is not None:
+            _jsonl.close()
+            _jsonl = None
+        _jsonl_pinned = True
+        if path:
+            _jsonl = JSONLSink(path)
+        return _jsonl
+
+
+def jsonl_emit(record: Dict) -> None:
+    """Write one record through the sink; no-op when unconfigured or
+    telemetry is disabled."""
+    if not enabled():
+        return
+    sink = jsonl_sink()
+    if sink is not None:
+        sink.emit(record)
+
+
+# -- /metrics HTTP ----------------------------------------------------------
+def serve_metrics(port: Optional[int] = None,
+                  host: Optional[str] = None) -> MetricsHTTPServer:
+    """Start (or return) the /metrics HTTP exporter. Default port from
+    ``MXTPU_METRICS_PORT``; bind address from ``MXTPU_METRICS_HOST``
+    (loopback unless widened explicitly)."""
+    global _http, _http_failed_port
+    with _lock:
+        if _http is not None:
+            # port 0 = "any port": never a mismatch with the live server
+            if port is not None and int(port) != 0 \
+                    and _http.port not in (None, int(port)):
+                import logging
+
+                logging.getLogger("mxtpu.telemetry").warning(
+                    "serve_metrics(port=%s): exporter already bound to "
+                    "port %s; one /metrics server per process — "
+                    "returning the existing one", port, _http.port)
+            return _http
+        from ..config import config
+
+        if port is None:
+            port = int(config.get("MXTPU_METRICS_PORT"))
+        if host is None:
+            host = str(config.get("MXTPU_METRICS_HOST"))
+        _http = MetricsHTTPServer(port=port, host=host).start()
+        _http_failed_port = None
+        return _http
+
+
+def maybe_start_http() -> Optional[MetricsHTTPServer]:
+    """Start the /metrics server iff ``MXTPU_METRICS_PORT`` > 0 (called
+    from every StepMeter-instrumented constructor; idempotent). Like
+    the JSONL sink the knob is live: while the port is unset a later
+    ``config.set('MXTPU_METRICS_PORT', ...)`` still auto-starts from
+    the next instrumented constructor. A port that failed to bind is
+    latched (no warning spam once per constructor); retargeting to a
+    *different* port retries, re-binding the same port after freeing
+    it takes an explicit ``serve_metrics()`` call."""
+    global _http_failed_port
+    if _http is not None:
+        return _http
+    if not enabled():
+        return None
+    from ..config import config
+
+    port = int(config.get("MXTPU_METRICS_PORT"))
+    if port <= 0 or port == _http_failed_port:
+        return None
+    try:
+        return serve_metrics(port)
+    except OSError as e:
+        # observability must never break the run: a taken port (second
+        # worker of a local multi-process launch, stale process) logs
+        # and moves on instead of crashing the trainer constructor;
+        # remember the port so only a retarget retries the bind
+        _http_failed_port = port
+        import logging
+
+        logging.getLogger("mxtpu.telemetry").warning(
+            "/metrics server not started on port %d: %s", port, e)
+        return None
+
+
+# -- test hygiene -----------------------------------------------------------
+def reset() -> None:
+    """Tear down the global state (tests): registry, watchdog, sink,
+    HTTP server."""
+    global _watchdog, _jsonl, _jsonl_cfg, _jsonl_pinned, _http, \
+        _http_failed_port
+    with _lock:
+        get_registry().reset()
+        if _watchdog is not None:
+            _watchdog.stop()
+            _watchdog = None
+        if _jsonl is not None:
+            _jsonl.close()
+        _jsonl = None
+        _jsonl_cfg = None
+        _jsonl_pinned = False
+        if _http is not None:
+            _http.stop()
+        _http = None
+        _http_failed_port = None

@@ -1,0 +1,215 @@
+"""Exporters: Prometheus text exposition, /metrics HTTP thread, JSONL sink.
+
+Three consumers, one registry:
+
+* **Prometheus pull** — :func:`prometheus_text` renders the registry in
+  the text exposition format; :class:`MetricsHTTPServer` serves it from
+  a stdlib ``http.server`` daemon thread on ``MXTPU_METRICS_PORT``
+  (0 = disabled, the default). No third-party dependency.
+* **JSONL file sink** — :class:`JSONLSink` appends one JSON object per
+  telemetry record (steps, recompiles, bench rows) to
+  ``MXTPU_TELEMETRY_JSONL``; ``tools/telemetry_report.py`` summarizes
+  and diffs these files.
+* The chrome-trace correlation lives in ``meters.py`` (telemetry events
+  are recorded into the running profiler's event stream so they line up
+  with host scopes and the XPlane trace on one timeline).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, \
+    get_registry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Prometheus metric names allow ``[a-zA-Z0-9_:]``; profiler counters
+    arrive with slashes (``serving/model/queue_depth``) — map every
+    illegal char to ``_`` at exposition time, keeping the raw name
+    everywhere else (chrome trace tracks, JSONL)."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _label_str(labels, extra: Optional[Dict[str, str]] = None) -> str:
+    items = list(labels) + (sorted(extra.items()) if extra else [])
+    if not items:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for k, v in items)
+    return "{" + body + "}"
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format 0.0.4."""
+    registry = registry if registry is not None else get_registry()
+    lines: List[str] = []
+    for name, kind, help_, insts in registry.collect():
+        pname = sanitize_metric_name(name)
+        if help_:
+            lines.append(f"# HELP {pname} {help_}")
+        lines.append(f"# TYPE {pname} {kind}")
+        for inst in insts:
+            if isinstance(inst, Histogram):
+                for bound, cum in inst.cumulative():
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_label_str(inst.labels, {'le': _fmt(bound)})}"
+                        f" {cum}")
+                lines.append(f"{pname}_sum{_label_str(inst.labels)} "
+                             f"{_fmt(inst.sum)}")
+                lines.append(f"{pname}_count{_label_str(inst.labels)} "
+                             f"{inst.count}")
+            elif isinstance(inst, (Counter, Gauge)):
+                lines.append(f"{pname}{_label_str(inst.labels)} "
+                             f"{_fmt(inst.value)}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Optional[MetricsRegistry] = None   # set per server subclass
+
+    def do_GET(self):                            # noqa: N802 (stdlib API)
+        if self.path.split("?")[0] not in ("/", "/metrics"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = prometheus_text(self.registry).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):           # silence per-scrape noise
+        pass
+
+
+class MetricsHTTPServer:
+    """Pull-exporter thread: GET /metrics → Prometheus text.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is in
+    ``.port`` after ``start()``.
+    """
+
+    def __init__(self, port: int = 0,
+                 registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1"):
+        # loopback by default: /metrics is unauthenticated, so exposing
+        # it beyond the host is an explicit operator decision
+        # (MXTPU_METRICS_HOST=0.0.0.0)
+        self._requested = (host, int(port))
+        self._registry = registry if registry is not None else get_registry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._httpd is not None:
+            return self
+        handler = type("Handler", (_MetricsHandler,),
+                       {"registry": self._registry})
+        self._httpd = ThreadingHTTPServer(self._requested, handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mxtpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class JSONLSink:
+    """Append-only JSON-lines sink, one object per record, flushed per
+    line so a crashed run still leaves a readable file. Each open
+    writes a ``run_start`` boundary record so a reused path stays
+    splittable into runs (``tools/telemetry_report.py`` summarizes the
+    last run by default)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+        self.emit({"kind": "run_start", "pid": os.getpid()})
+
+    def emit(self, record: Dict) -> None:
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            # a concurrent close (set_jsonl(None)/reset from another
+            # thread) must drop the record, not raise into a training
+            # step or jax's compile listener
+            if self._f.closed:
+                return
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except OSError as e:
+                # observability must never break the run: a full disk
+                # or revoked fd disables the sink (the closed-file
+                # early-return above makes every later emit a no-op)
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                logging.getLogger("mxtpu.telemetry").warning(
+                    "JSONL sink disabled after write failure on %s: %s",
+                    self.path, e)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def read_jsonl(path: str) -> List[Dict]:
+    """Replay a JSONL telemetry file (skips blank/corrupt lines — a
+    crashed writer may leave a torn final line)."""
+    out: List[Dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,303 @@
+"""Typed metrics registry: Counter / Gauge / Histogram.
+
+The storage layer of ``mxtpu.telemetry`` (docs/OBSERVABILITY.md). The
+reference framework operates through always-on runtime stats — MXNet's
+profiler aggregate tables, monitor callbacks, and KVStore server stats
+(SURVEY.md §5 "Tracing/profiling") — and TF's system paper
+(arXiv:1605.08695) makes the design point explicit: a system at scale is
+operated through its *metrics*, not its logs. This registry is the one
+namespace every subsystem (trainer, SPMD, pipeline, serving, profiler
+counters) reports into, and the one surface every exporter reads from.
+
+Design:
+
+* Instruments are keyed by ``(name, sorted labels)`` — Prometheus data
+  model, so the text exposition in ``exporters.py`` is a direct walk.
+* Every instrument is thread-safe (serving observes from worker threads
+  while the exporter thread reads).
+* Histograms use **fixed buckets** (cumulative counts + sum + count) so
+  quantile reads are O(buckets), allocation-free, and mergeable across
+  processes — not a sliding reservoir.
+* Zero-cost-when-disabled: the package front door hands out the shared
+  ``NULL`` instrument when ``MXTPU_TELEMETRY=0``; every method on it is
+  a no-op and no per-call allocation happens.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
+    "NullInstrument", "DEFAULT_TIME_BUCKETS", "get_registry",
+]
+
+#: step/latency buckets in seconds: 100us .. 60s, roughly 2.5x spacing —
+#: wide enough for a 250us serving forward and a 10s+ pipeline step
+DEFAULT_TIME_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _labels_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    """Base: name + frozen labels + a lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = _labels_key(labels)
+        self._lock = threading.Lock()
+
+
+class Counter(_Instrument):
+    """Monotonically increasing value."""
+
+    kind = "counter"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Instrument):
+    """Point-in-time value (queue depth, MFU, bytes in use)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram with quantile estimation.
+
+    ``buckets`` are inclusive upper bounds; a ``+Inf`` bucket is
+    implicit. ``quantile(p)`` linearly interpolates inside the bucket
+    holding the p-th observation — the fixed-bucket estimator Prometheus
+    servers run, computed here so the report CLI and tests don't need a
+    scrape stack.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, labels,
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        super().__init__(name, labels)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        self._counts = [0] * (len(bs) + 1)      # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._max = float("-inf")
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] including +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for b, c in zip(self.buckets + (float("inf"),), counts):
+            acc += c
+            out.append((b, acc))
+        return out
+
+    def quantile(self, p: float) -> float:
+        """Estimated p-quantile (p in [0, 100])."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            hi = self._max
+        if total == 0:
+            return 0.0
+        target = max(1.0, p / 100.0 * total)
+        acc = 0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            if acc + c >= target:
+                if i == len(self.buckets):
+                    # overflow bucket has no upper bound to interpolate
+                    # against; the observed max is the honest answer
+                    return hi
+                upper = self.buckets[i]
+                if c == 0:
+                    return upper
+                frac = (target - acc) / c
+                # clamp: float interpolation must not exceed the bound
+                return min(upper, lo + frac * (max(upper, lo) - lo))
+            acc += c
+            lo = self.buckets[i] if i < len(self.buckets) else hi
+        return hi if hi != float("-inf") else 0.0
+
+
+class NullInstrument:
+    """The disabled-mode instrument: one shared instance, every method a
+    no-op, zero per-call allocation. Supports the full surface of all
+    three instrument kinds so call sites never branch."""
+
+    __slots__ = ()
+    kind = "null"
+    name = ""
+    labels = ()
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount=1.0):
+        pass
+
+    def dec(self, amount=1.0):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+    def quantile(self, p):
+        return 0.0
+
+    def cumulative(self):
+        return []
+
+
+NULL = NullInstrument()
+
+
+class MetricsRegistry:
+    """Get-or-create instrument store, one per process by default.
+
+    The same ``(name, labels)`` always returns the same instrument; the
+    same name with a different *kind* is a programming error and raises.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple, _Instrument] = {}
+        self._kinds: Dict[str, str] = {}
+        self._helps: Dict[str, str] = {}
+
+    def _get(self, cls, name: str, help: str, labels: Dict[str, str],
+             **kwargs):
+        key = (name, _labels_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is not None:
+                if inst.kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.kind}, requested {cls.kind}")
+                return inst
+            if self._kinds.get(name, cls.kind) != cls.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{self._kinds[name]}, requested {cls.kind}")
+            inst = cls(name, labels, **kwargs)
+            self._instruments[key] = inst
+            self._kinds[name] = cls.kind
+            if help:
+                self._helps[name] = help
+            return inst
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, help, labels,
+                         buckets=buckets if buckets is not None
+                         else DEFAULT_TIME_BUCKETS)
+
+    def find(self, name: str, **labels):
+        """The live instrument for (name, labels), or None."""
+        return self._instruments.get((name, _labels_key(labels)))
+
+    def collect(self) -> Iterable[Tuple[str, str, str, List[_Instrument]]]:
+        """Yield (name, kind, help, [instruments]) sorted by name, each
+        family's instruments sorted by labels — exporter walk order."""
+        with self._lock:
+            by_name: Dict[str, List[_Instrument]] = {}
+            for inst in self._instruments.values():
+                by_name.setdefault(inst.name, []).append(inst)
+            kinds = dict(self._kinds)
+            helps = dict(self._helps)
+        for name in sorted(by_name):
+            insts = sorted(by_name[name], key=lambda i: i.labels)
+            yield name, kinds.get(name, "untyped"), \
+                helps.get(name, ""), insts
+
+    def reset(self) -> None:
+        """Drop every instrument (tests)."""
+        with self._lock:
+            self._instruments.clear()
+            self._kinds.clear()
+            self._helps.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every exporter serves."""
+    return _registry

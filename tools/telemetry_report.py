@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Summarize / diff mxtpu.telemetry JSONL runs (docs/OBSERVABILITY.md).
+
+Summary mode — per site: step count, p50/p95 step wall time, MFU trend
+(first→last EMA window), recompiles flagged, device-memory high-water;
+plus any bench rows the file carries::
+
+    python tools/telemetry_report.py run.jsonl
+
+Compare mode — per-metric deltas between two runs (the BENCH_r* diff
+tool: point it at the JSONL sinks of two bench.py / serving_bench.py
+invocations)::
+
+    python tools/telemetry_report.py --compare a.jsonl b.jsonl
+
+Only stdlib + the sibling package's reader are used, so this runs on a
+box without jax installed (the JSONL file is plain JSON objects).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _read(path: str) -> List[Dict]:
+    try:
+        from incubator_mxnet_tpu.telemetry import read_jsonl
+
+        return read_jsonl(path)
+    except ImportError:          # jax-less box: inline the tolerant reader
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return out
+
+
+def _select_run(records: List[Dict], merge: bool = False):
+    """The sink writes a ``run_start`` boundary record each time it
+    opens, and the file is append-mode — a reused path holds several
+    runs. Default to the newest run that has records (mixing runs
+    silently doubles step counts and skews percentiles); ``--all``
+    merges. Returns ``(records, n_skipped_runs)``."""
+    if merge:
+        return [r for r in records if r.get("kind") != "run_start"], 0
+    runs: List[List[Dict]] = [[]]
+    for r in records:
+        if r.get("kind") == "run_start":
+            runs.append([])
+        else:
+            runs[-1].append(r)
+    runs = [seg for seg in runs if seg]
+    if not runs:
+        return [], 0
+    return runs[-1], len(runs) - 1
+
+
+def _pctl(vals: List[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, int(round(p / 100.0 * len(s))) - 1))]
+
+
+def _group_steps(records: List[Dict]) -> Dict[str, List[Dict]]:
+    sites: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "step":
+            sites.setdefault(r.get("site", "?"), []).append(r)
+    return sites
+
+
+def _mfu_trend(steps: List[Dict]) -> Optional[str]:
+    mfus = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
+    if not mfus:
+        return None
+    k = max(1, len(mfus) // 5)
+    first = sum(mfus[:k]) / k
+    last = sum(mfus[-k:]) / k
+    arrow = "->"
+    return f"{first:.1f}% {arrow} {last:.1f}%"
+
+
+def summarize(path: str, merge: bool = False) -> str:
+    records, skipped = _select_run(_read(path), merge=merge)
+    head = f"telemetry report — {path} ({len(records)} records"
+    if skipped:
+        head += f"; newest of {skipped + 1} runs, --all merges"
+    lines = [head + ")"]
+    sites = _group_steps(records)
+    recompiles: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "recompile":
+            recompiles[r.get("site", "?")] = \
+                recompiles.get(r.get("site", "?"), 0) + 1
+    if sites:
+        lines.append("")
+        lines.append(f"{'site':24s} {'steps':>7s} {'p50 ms':>9s} "
+                     f"{'p95 ms':>9s} {'MFU trend':>16s} {'recompiles':>11s}")
+        for site in sorted(sites):
+            steps = sites[site]
+            # compile-dominated steps carry "compiled": true for exactly
+            # this exclusion (the meter keeps them out of EMA/MFU too) —
+            # else a cold run's p95 is its compile time, not step time
+            walls = [r["wall_ms"] for r in steps
+                     if "wall_ms" in r and not r.get("compiled")]
+            trend = _mfu_trend(steps) or "-"
+            lines.append(
+                f"{site:24s} {sum(r.get('fused_steps', 1) for r in steps):7d} "
+                f"{_pctl(walls, 50):9.3f} {_pctl(walls, 95):9.3f} "
+                f"{trend:>16s} {recompiles.get(site, 0):11d}")
+    for site, n in sorted(recompiles.items()):
+        if site not in sites:
+            lines.append(f"recompiles at un-stepped site {site}: {n}")
+    peaks = [r["mem_peak_bytes"] for r in records
+             if r.get("mem_peak_bytes") is not None]
+    live = [r["mem_bytes_in_use"] for r in records
+            if r.get("mem_bytes_in_use") is not None]
+    if peaks or live:
+        lines.append("")
+        if peaks:
+            lines.append(f"device memory high-water: "
+                         f"{max(peaks) / 2**20:.1f} MiB (peak)")
+        if live:
+            lines.append(f"device memory max live:   "
+                         f"{max(live) / 2**20:.1f} MiB")
+    bench = [r for r in records if r.get("kind") == "bench"]
+    if bench:
+        lines.append("")
+        lines.append(f"{'bench metric':44s} {'value':>12s} {'unit':>18s}")
+        for r in bench:
+            lines.append(f"{str(r.get('metric', '?')):44s} "
+                         f"{r.get('value', 0):12.2f} "
+                         f"{str(r.get('unit', '')):>18s}")
+    return "\n".join(lines)
+
+
+def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
+    """Flatten a run into {metric_key: value} for diffing: bench rows by
+    metric name, per-site step p50/p95 and final MFU, recompile counts."""
+    out: Dict[str, float] = {}
+    for r in records:
+        if r.get("kind") == "bench" and "metric" in r \
+                and isinstance(r.get("value"), (int, float)):
+            out[f"bench/{r['metric']}"] = float(r["value"])
+            if isinstance(r.get("mfu_pct"), (int, float)):
+                out[f"bench/{r['metric']}/mfu_pct"] = float(r["mfu_pct"])
+    for site, steps in _group_steps(records).items():
+        walls = [r["wall_ms"] for r in steps
+                 if "wall_ms" in r and not r.get("compiled")]
+        if walls:
+            out[f"step/{site}/p50_ms"] = _pctl(walls, 50)
+            out[f"step/{site}/p95_ms"] = _pctl(walls, 95)
+        mfus = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
+        if mfus:
+            out[f"step/{site}/mfu_pct"] = mfus[-1]
+    n_rec: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "recompile":
+            site = r.get("site", "?")
+            n_rec[site] = n_rec.get(site, 0) + 1
+    for site, n in n_rec.items():
+        out[f"recompiles/{site}"] = float(n)
+    return out
+
+
+def compare(path_a: str, path_b: str, merge: bool = False) -> str:
+    a = _comparable_metrics(_select_run(_read(path_a), merge=merge)[0])
+    b = _comparable_metrics(_select_run(_read(path_b), merge=merge)[0])
+    keys = sorted(set(a) | set(b))
+    lines = [f"telemetry compare — A={path_a}  B={path_b}",
+             "",
+             f"{'metric':44s} {'A':>12s} {'B':>12s} {'delta':>9s}"]
+    for k in keys:
+        va, vb = a.get(k), b.get(k)
+        if va is None or vb is None:
+            lines.append(f"{k:44s} "
+                         f"{'-' if va is None else format(va, '12.3f'):>12s} "
+                         f"{'-' if vb is None else format(vb, '12.3f'):>12s} "
+                         f"{'only ' + ('B' if va is None else 'A'):>9s}")
+            continue
+        if va:
+            delta = f"{100.0 * (vb - va) / abs(va):+8.1f}%"
+        else:
+            delta = "   n/a" if vb == 0 else "   new"
+        lines.append(f"{k:44s} {va:12.3f} {vb:12.3f} {delta:>9s}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize or diff mxtpu telemetry JSONL runs")
+    ap.add_argument("paths", nargs="*", help="one JSONL file to summarize")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="diff two JSONL runs per metric")
+    ap.add_argument("--all", action="store_true",
+                    help="merge every run in the file instead of only "
+                         "the newest (files are append-mode; each sink "
+                         "open writes a run_start boundary)")
+    args = ap.parse_args(argv)
+    if args.compare:
+        print(compare(*args.compare, merge=args.all))
+        return 0
+    if len(args.paths) != 1:
+        ap.error("pass exactly one JSONL path, or --compare A B")
+    print(summarize(args.paths[0], merge=args.all))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
