@@ -38,6 +38,10 @@ ANCHORS = {
     "lstm_ptb": 20_000.0,
     "bert_base": 220.0,
     "ssd300": 180.0,
+    # speedup of the DevicePrefetcher feed over the synchronous feed
+    # with a synthetic-slow host source (benchmark/data_bench.py);
+    # anchor 1.0 = no overlap, so vs_baseline IS the speedup
+    "data_pipeline": 1.0,
     "resnet50": 800.0,
 }
 
@@ -391,11 +395,31 @@ def bench_resnet():
             _tfs(trainer, (x, y), per, n_dev))
 
 
+def bench_data_pipeline():
+    """config[5]: input-pipeline overlap — DevicePrefetcher vs the
+    synchronous feed with a synthetic-slow host source (docs/DATA.md,
+    benchmark/data_bench.py). The recorded value is the speedup (x);
+    anchor 1.0, so ``vs_baseline`` IS the overlap factor. No MFU row —
+    the metric is feed overlap, not chip FLOPs."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.data_bench import compare_feeds
+
+    sync_per, pre_per, _ = compare_feeds(steps=30, item_ms=20.0)
+    if pre_per <= 0:
+        raise RuntimeError("prefetch feed produced no steps")
+    return (sync_per / pre_per, "x_speedup_vs_sync_feed",
+            "data_pipeline_prefetch_speedup", "data_pipeline", None)
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert,
     "ssd300": bench_ssd,
+    "data_pipeline": bench_data_pipeline,
     "resnet50": bench_resnet,  # headline — always last
 }
 

@@ -63,7 +63,8 @@ def __getattr__(name):
                 "model", "executor", "model_zoo", "test_utils", "onnx",
                 "operator", "contrib", "np", "npx", "rtc", "callback",
                 "monitor", "visualization", "viz", "name", "attribute",
-                "util", "engine", "registry", "serving", "telemetry"):
+                "util", "engine", "registry", "serving", "telemetry",
+                "data"):
         import importlib
 
         mod = importlib.import_module(

@@ -228,6 +228,26 @@ config.register(
     "The gauge uses bench.py's canonical formula against the measured "
     "ceiling (MXTPU_BENCH_CEILING_TFS).")
 config.register(
+    "MXTPU_DATA_PREFETCH_DEPTH", 2, int,
+    "Default number of batches a data.DevicePrefetcher stages on device "
+    "ahead of the consumer (docs/DATA.md). 2 is enough to overlap the "
+    "H2D transfer of batch t+1 with the compute of batch t; raise it "
+    "only when per-batch host ETL time is spiky.")
+config.register(
+    "MXTPU_DATA_WORKERS", 0, int,
+    "Default worker-thread count for data pipeline .map() stages "
+    "(0 = run the map fn inline on the consumer thread). Per-stage "
+    "num_workers= overrides.")
+config.register(
+    "MXTPU_DATA_HOST_PREFETCH", 2, int,
+    "Default bounded-queue depth for data pipeline .prefetch() stages "
+    "(host-side ETL decoupling; backpressured, never unbounded).")
+config.register(
+    "MXTPU_DATA_SHUFFLE_BUFFER", 1024, int,
+    "Default pool size for data pipeline .shuffle() stages (streaming "
+    "pool shuffle, the reference iterator's shuffle_chunk analog). "
+    "Larger = closer to a uniform shuffle, more resident samples.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

@@ -1,0 +1,44 @@
+"""``mxtpu.data`` — the TPU-native input pipeline (docs/DATA.md).
+
+Feeding the accelerator ahead of the step instead of blocking the step
+on the feed: chainable host-ETL stages with bounded workers and
+backpressure (``pipeline``), asynchronous device staging with the
+consumer's sharding (``device_prefetch``), and checkpointable iteration
+state for bit-exact mid-epoch resume (``state``) — the input-side
+counterpart of the fused train step (docs/TRAINING.md) and the SPMD
+trainers (docs/SCALING.md), instrumented through ``mxtpu.telemetry``
+(the ``mxtpu_data_*`` family, docs/OBSERVABILITY.md).
+
+Quick start::
+
+    from incubator_mxnet_tpu import data
+
+    pipe = (data.from_ndarray(x, y)
+            .shuffle(seed=0)
+            .shard(jax.process_index(), jax.process_count())
+            .batch(128)
+            .map(augment, num_workers=4)
+            .prefetch(2))
+
+    feed = trainer.device_prefetcher(pipe)    # batches staged in HBM
+    for xb, yb in feed:
+        loss = trainer.step(xb, yb)
+
+    sd = feed.state_dict()                    # mid-epoch checkpoint
+    feed.load_state_dict(sd)                  # bit-identical remainder
+
+The legacy ``mx.io`` DataIter family remains for MXNet-parity scripts;
+new code should compose these stages.
+"""
+
+from .pipeline import Stage, from_iter, from_ndarray, from_recordio
+from .device_prefetch import DevicePrefetcher, device_prefetcher
+from .state import (iterator_state, load_iterator_state,
+                    load_iterator_state_file, save_iterator_state_file)
+
+__all__ = [
+    "DevicePrefetcher", "Stage", "device_prefetcher", "from_iter",
+    "from_ndarray", "from_recordio", "iterator_state",
+    "load_iterator_state", "load_iterator_state_file",
+    "save_iterator_state_file",
+]

@@ -1,0 +1,291 @@
+"""Device-side prefetch: stage the next batches in HBM ahead of the step.
+
+The device half of ``mxtpu.data`` (docs/DATA.md). Every trainer used to
+block on a synchronous ``jax.device_put`` inside ``step`` — host ETL and
+the H2D transfer serialized with device compute, the classic way a TPU
+goes input-bound. :class:`DevicePrefetcher` moves the ``device_put`` to
+a background thread and keeps up to ``depth`` batches resident on device
+with the consumer's sharding, so the transfer of batch ``t+1`` overlaps
+the compute of batch ``t`` (the TF-paper prefetch pipeline,
+arXiv:1605.08695 §4.2; PJRT transfers are async once issued, so issuing
+them early is the entire trick).
+
+Shardings supported (the ``sharding`` argument):
+
+* ``None`` — default-device placement (single-chip ``gluon.Trainer``);
+* a ``jax.sharding.Sharding`` — applied to every array leaf
+  (``SPMDTrainer``'s batch-axis ``NamedSharding``, a
+  ``PipelineTrainer`` microbatch layout);
+* a callable ``leaf -> sharding-or-None`` for per-leaf layouts.
+
+Prefer the trainer factories, which pass the right sharding::
+
+    feed = st.device_prefetcher(pipe)        # SPMDTrainer
+    for x, y in feed:
+        st.step(x, y)                        # device_put now a no-op
+
+Telemetry (``mxtpu_data_*``, docs/OBSERVABILITY.md): queue-depth gauge,
+producer/consumer wait histograms, ``mxtpu_data_input_bound_fraction``
+— the EMA share of wall time the consumer spent waiting for data; near
+0 means the pipeline keeps up, near 1 means the TPU is input-bound.
+
+Resumable: ``state_dict()`` forwards to the wrapped pipeline with the
+cursor rewound to the batches actually *delivered* (in-flight staged
+batches are re-produced on restore, never lost).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+__all__ = ["DevicePrefetcher", "device_prefetcher"]
+
+_EMA_ALPHA = 0.3
+_JSONL_EVERY = 50
+
+
+def _place_fn(sharding):
+    """leaf -> device array, resolving the sharding argument forms."""
+    import jax
+
+    def place(leaf):
+        from ..ndarray import NDArray
+
+        if isinstance(leaf, NDArray):
+            leaf = leaf._data
+        s = sharding(leaf) if callable(sharding) else sharding
+        if s is None:
+            return jax.device_put(leaf)
+        return jax.device_put(leaf, s)
+
+    return place
+
+
+def _tree_place(item, place):
+    from ..io import DataBatch
+
+    if isinstance(item, DataBatch):
+        return DataBatch(
+            [_tree_place(d, place) for d in (item.data or [])],
+            [_tree_place(l, place) for l in (item.label or [])],
+            pad=item.pad, index=item.index)
+    if isinstance(item, tuple):
+        return tuple(_tree_place(v, place) for v in item)
+    if isinstance(item, list):
+        return [_tree_place(v, place) for v in item]
+    if isinstance(item, dict):
+        return {k: _tree_place(v, place) for k, v in item.items()}
+    return place(item)
+
+
+class DevicePrefetcher:
+    """Asynchronously stage the next ``depth`` batches on device.
+
+    ``source`` is iterated one epoch per ``for`` loop (a ``mxtpu.data``
+    pipeline, a ``gluon.data.DataLoader``, or any re-iterable); each
+    yielded item's array leaves (np/NDArray/jax arrays, nested in
+    tuples/lists/dicts/``DataBatch``) are placed with ``sharding``.
+    ``depth`` defaults to ``MXTPU_DATA_PREFETCH_DEPTH``.
+    """
+
+    def __init__(self, source: Iterable, sharding=None,
+                 depth: Optional[int] = None, site: str = "data"):
+        from ..config import config
+
+        self._source = source
+        self._place = _place_fn(sharding)
+        if depth is None:
+            depth = int(config.get("MXTPU_DATA_PREFETCH_DEPTH"))
+        self.depth = max(1, int(depth))
+        self.site = site
+        self._producer = None        # _QueueProducer while an epoch runs
+        self._delivered = 0          # this epoch (absolute within epoch)
+        self._resume_base = 0        # set by load_state_dict
+        self._last_return: Optional[float] = None
+        self._bound_ema: Optional[float] = None
+        self._insts = None
+        self._closed = False
+        # True only between an epoch's end (or a consumed producer
+        # error) and the next explicit __iter__/load_state_dict — a
+        # fresh prefetcher starts its first epoch from either __iter__
+        # or a bare __next__
+        self._epoch_done = False
+
+    # -- telemetry ----------------------------------------------------------
+    def _instruments(self):
+        if self._insts is None:
+            from .. import telemetry
+
+            s = {"site": self.site}
+            self._insts = {
+                "depth": telemetry.gauge(
+                    "mxtpu_data_device_queue_depth",
+                    "batches staged on device ahead of the consumer",
+                    **s),
+                "batches": telemetry.counter(
+                    "mxtpu_data_batches_total",
+                    "batches delivered to the consumer", **s),
+                "producer_wait": telemetry.histogram(
+                    "mxtpu_data_producer_wait_seconds",
+                    "time a pipeline producer blocked on a full queue",
+                    stage=self.site),
+                "consumer_wait": telemetry.histogram(
+                    "mxtpu_data_consumer_wait_seconds",
+                    "time a pipeline consumer blocked on an empty queue",
+                    stage=self.site),
+                "bound": telemetry.gauge(
+                    "mxtpu_data_input_bound_fraction",
+                    "EMA share of consumer wall time spent waiting on "
+                    "input (1.0 = fully input-bound)", **s),
+            }
+        return self._insts
+
+    def _emit(self, final: bool = False):
+        from .. import telemetry
+
+        rec: Dict[str, Any] = {"kind": "data", "site": self.site,
+                               "batches": self._delivered,
+                               "queue_depth": self.queue_depth()}
+        if self._bound_ema is not None:
+            rec["input_bound_pct"] = round(100.0 * self._bound_ema, 2)
+        if final:
+            rec["epoch_end"] = True
+        telemetry.jsonl_emit(rec)
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        if self._closed:
+            raise RuntimeError("DevicePrefetcher is closed")
+        # mid-epoch (a live producer, or a just-restored state) iteration
+        # CONTINUES the current epoch; a fresh/finished one starts anew
+        if self._producer is None or self._epoch_done:
+            self._start_epoch()
+        return self
+
+    def _start_epoch(self):
+        from .pipeline import _QueueProducer
+
+        self._join()
+        self._epoch_done = False
+        # after a mid-epoch restore the delivered count continues from
+        # the restored cursor so a later state_dict() stays absolute
+        self._delivered = self._resume_base
+        self._resume_base = 0
+        self._last_return = None
+        state = {}
+
+        def nxt():
+            # the epoch iterator is created lazily on the producer
+            # thread; device_put is async — this ISSUES the transfer
+            # and returns, the copy itself overlaps the running step
+            if "it" not in state:
+                state["it"] = iter(self._source)
+            return _tree_place(next(state["it"]), self._place)
+
+        self._producer = _QueueProducer(
+            nxt, self.depth, self._instruments(),
+            name="mxtpu-data-device-prefetch")
+
+    def __next__(self):
+        from .pipeline import _QueueProducer
+
+        if self._producer is None:
+            if self._epoch_done:
+                # iterator contract: keep raising after the epoch ends
+                # (and after a consumed producer error) — __iter__ or
+                # load_state_dict starts the next epoch explicitly
+                raise StopIteration
+            self._start_epoch()
+        insts = self._instruments()
+        ok, item, wait = self._producer.get()
+        now = time.perf_counter()
+        if not ok:
+            self._epoch_done = True
+            self._join()
+            raise item
+        if item is _QueueProducer.DONE:
+            self._epoch_done = True
+            self._join()
+            self._emit(final=True)
+            raise StopIteration
+        # input-bound fraction: share of the inter-batch interval spent
+        # blocked on the queue (compute + step time is the rest)
+        if self._last_return is not None:
+            interval = max(now - self._last_return, 1e-9)
+            frac = min(1.0, wait / interval)
+            self._bound_ema = frac if self._bound_ema is None else \
+                (1 - _EMA_ALPHA) * self._bound_ema + _EMA_ALPHA * frac
+            insts["bound"].set(self._bound_ema)
+        self._last_return = now
+        self._delivered += 1
+        insts["batches"].inc()
+        if self._delivered % _JSONL_EVERY == 0:
+            self._emit()
+        return item
+
+    def queue_depth(self) -> int:
+        """Batches currently staged on device ahead of the consumer."""
+        return self._producer.qsize() if self._producer is not None else 0
+
+    @property
+    def input_bound_fraction(self) -> Optional[float]:
+        """EMA share of consumer wall time spent waiting on input."""
+        return self._bound_ema
+
+    # -- resumable state ----------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Pipeline state with the cursor rewound to the batches this
+        prefetcher actually DELIVERED — staged-but-unconsumed batches
+        are re-produced after restore, never lost or double-fed."""
+        if not hasattr(self._source, "state_dict"):
+            raise TypeError(
+                f"source {type(self._source).__name__} is not resumable "
+                "(no state_dict) — wrap an mxtpu.data pipeline")
+        return {"kind": "device_prefetch", "cursor": self._delivered,
+                "source": self._source.state_dict()}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        if sd.get("kind") != "device_prefetch":
+            raise ValueError(f"not a DevicePrefetcher state: "
+                             f"{sd.get('kind')!r}")
+        self._join()
+        inner = dict(sd["source"])
+        inner["cursor"] = int(sd["cursor"])
+        self._source.load_state_dict(inner)
+        self._resume_base = int(sd["cursor"])
+        self._epoch_done = False     # restored mid-epoch: next use resumes
+        self._last_return = None
+
+    # -- teardown -----------------------------------------------------------
+    def _join(self):
+        if self._producer is not None:
+            self._producer.join()
+            self._producer = None
+
+    def close(self) -> None:
+        """Stop the producer and close the wrapped source. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._join()
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def device_prefetcher(source: Iterable, sharding=None,
+                      depth: Optional[int] = None,
+                      site: str = "data") -> DevicePrefetcher:
+    """Functional spelling of :class:`DevicePrefetcher` (the trainer
+    methods ``SPMDTrainer.device_prefetcher`` etc. pass their batch
+    sharding here)."""
+    return DevicePrefetcher(source, sharding=sharding, depth=depth,
+                            site=site)

@@ -1,0 +1,808 @@
+"""Chainable host-ETL pipeline stages (``mxtpu.data``).
+
+The host half of the TPU-native input pipeline (docs/DATA.md): a pull
+chain of composable stages —
+
+    from_ndarray / from_iter / from_recordio
+        -> shuffle(seed)            (streaming pool, per-epoch rng)
+        -> shard(index, count)      (round-robin by sample)
+        -> batch(n)                 (np.stack leaves)
+        -> map(fn, num_workers)     (bounded thread pool, ordered)
+        -> prefetch(depth)          (background producer, bounded queue)
+
+The TF system paper (arXiv:1605.08695 §4.2) feeds the accelerator from
+exactly this shape of pipeline; the reference's C++ analog is the
+iter_image_recordio_2.cc prefetch/decode chain (SURVEY.md §2.1). The
+``io/`` DataIter family is the MXNet-parity port of the *protocol*;
+this module is the subsystem the trainers prefer
+(``data.device_prefetch.DevicePrefetcher`` stages the device half).
+
+Contracts every stage keeps:
+
+* **Determinism** — given the stage's static config (seed) and its
+  ``(epoch, cursor)`` state, the remaining item stream is a pure
+  function: that is what makes :meth:`Stage.state_dict` /
+  :meth:`Stage.load_state_dict` bit-exact (restore = re-derive the
+  epoch's stream and fast-forward, with O(1) shortcuts where the stage
+  supports them — see ``skip``). ``map`` functions must therefore be
+  deterministic per item; seed data-augmentation from values carried in
+  the item itself.
+* **Bounded buffering with backpressure** — worker pools and prefetch
+  queues have fixed depth; a slow consumer blocks the producer, never
+  an unbounded queue.
+* **Exception propagation** — an exception raised by a source or a map
+  fn on a worker thread re-raises at the consumer's next ``next()``
+  (no silent worker death, no deadlock; the legacy ``PrefetchingIter``
+  bug class). ``close()`` joins every worker deterministically.
+
+One epoch per ``for`` loop: iterating a pipeline yields the current
+epoch and stops; iterating again starts the next epoch (fresh shuffle
+order). A pipeline restored mid-epoch resumes where the state was
+taken.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Stage", "from_iter", "from_ndarray", "from_recordio"]
+
+
+def _cfg(name: str):
+    from ..config import config
+
+    return config.get(name)
+
+
+def _data_instruments(stage_label: str):
+    """The mxtpu_data_* host-side family for one stage instance."""
+    from .. import telemetry
+
+    s = {"stage": stage_label}
+    return {
+        "depth": telemetry.gauge(
+            "mxtpu_data_host_queue_depth",
+            "items staged in a host prefetch queue", **s),
+        "producer_wait": telemetry.histogram(
+            "mxtpu_data_producer_wait_seconds",
+            "time a pipeline producer blocked on a full queue", **s),
+        "consumer_wait": telemetry.histogram(
+            "mxtpu_data_consumer_wait_seconds",
+            "time a pipeline consumer blocked on an empty queue", **s),
+    }
+
+
+class _QueueProducer:
+    """Shared bounded-producer machinery for the prefetch stages (host
+    ``_Prefetch`` and the device ``DevicePrefetcher``): a daemon thread
+    pulls items from ``next_fn`` and stages ``(ok, item)`` tuples in a
+    bounded queue — ``(True, DONE)`` at end of stream, ``(False, exc)``
+    on any producer-side exception (so a dying worker surfaces at the
+    consumer, never a hang). ``join()`` drains and stops the thread
+    deterministically.
+
+    ``insts`` must carry ``depth``/``producer_wait``/``consumer_wait``
+    instruments (the ``mxtpu_data_*`` family, or NULL no-ops)."""
+
+    DONE = object()
+
+    def __init__(self, next_fn, depth: int, insts, name: str):
+        import time
+
+        self._time = time.perf_counter
+        self.q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._insts = insts
+        self._next_fn = next_fn
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def _produce(self):
+        insts = self._insts
+        while not self._stop.is_set():
+            try:
+                item = (True, self._next_fn())
+            except StopIteration:
+                item = (True, self.DONE)
+            except BaseException as e:      # propagate, never strand
+                item = (False, e)
+            t0 = self._time()
+            while not self._stop.is_set():
+                try:
+                    self.q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            insts["producer_wait"].observe(self._time() - t0)
+            insts["depth"].set(self.q.qsize())
+            if not item[0] or item[1] is self.DONE:
+                return
+
+    def get(self):
+        """Blocking take: ``(ok, item, consumer_wait_seconds)``."""
+        t0 = self._time()
+        ok, item = self.q.get()
+        wait = self._time() - t0
+        self._insts["consumer_wait"].observe(wait)
+        self._insts["depth"].set(self.q.qsize())
+        return ok, item, wait
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def join(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            # unblock a producer stuck on a full queue
+            try:
+                while True:
+                    self.q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5.0)
+
+
+class _EpochIterator:
+    """One epoch's view of a stage (what ``for item in pipe`` drives)."""
+
+    __slots__ = ("_stage",)
+
+    def __init__(self, stage: "Stage"):
+        self._stage = stage
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._stage._pull()
+
+
+class Stage:
+    """Base pipeline stage: a resumable, closable, chainable iterator.
+
+    Subclasses implement ``_next()`` (produce one item or raise
+    StopIteration at epoch end) and may override ``_start_epoch()``
+    (derive per-epoch state from ``self._epoch``), ``_skip(n)`` (an
+    O(1)-or-better fast-forward) and ``_own_state()`` /
+    ``_load_own_state(sd)`` for extra introspection state.
+    """
+
+    kind = "stage"
+
+    def __init__(self, source: Optional["Stage"] = None):
+        self._source = source
+        self._epoch = 0
+        self._cursor = 0          # items emitted this epoch
+        self._started = False     # _start_epoch ran for self._epoch
+        self._finished = False    # epoch exhausted; next iter() resets
+        self._closed = False
+
+    # -- chaining builders --------------------------------------------------
+    def map(self, fn: Callable[[Any], Any],
+            num_workers: Optional[int] = None) -> "Stage":
+        """Apply ``fn`` per item; ``num_workers > 0`` runs it on a
+        bounded thread pool (ordered results, backpressured submit-ahead
+        window, exceptions re-raised at the consumer). Default worker
+        count from ``MXTPU_DATA_WORKERS`` (0 = inline)."""
+        return _Map(self, fn, num_workers)
+
+    def batch(self, batch_size: int, drop_last: bool = False) -> "Stage":
+        """Group ``batch_size`` items, stacking array leaves with
+        ``np.stack`` (tuples/lists stack leaf-wise). The final partial
+        batch is emitted unless ``drop_last``."""
+        return _Batch(self, batch_size, drop_last)
+
+    def shuffle(self, buffer_size: Optional[int] = None,
+                seed: int = 0) -> "Stage":
+        """Streaming pool shuffle (the reference iterator's
+        shuffle_chunk pool): fill a ``buffer_size`` pool, emit a random
+        element, refill. Seeded per epoch with ``(seed, epoch)`` so
+        every epoch has a fresh but reproducible order. Default pool
+        from ``MXTPU_DATA_SHUFFLE_BUFFER``."""
+        return _Shuffle(self, buffer_size, seed)
+
+    def shard(self, shard_index: int, shard_count: int) -> "Stage":
+        """Keep every ``shard_count``-th item starting at
+        ``shard_index`` — the multi-process split (pass
+        ``jax.process_index()/process_count()``). Place BEFORE
+        ``batch`` so every process sees whole per-process batches."""
+        return _Shard(self, shard_index, shard_count)
+
+    def prefetch(self, depth: Optional[int] = None,
+                 name: Optional[str] = None) -> "Stage":
+        """Decouple host ETL from the consumer: a background producer
+        thread stages up to ``depth`` items in a bounded queue. Default
+        depth from ``MXTPU_DATA_HOST_PREFETCH``. ``name`` labels this
+        stage's ``mxtpu_data_*`` instruments (default ``"prefetch"`` —
+        shared by every unnamed stage, so name concurrent pipelines
+        whose gauges must read independently)."""
+        return _Prefetch(self, depth, name)
+
+    # -- iteration protocol -------------------------------------------------
+    def __iter__(self):
+        if self._closed:
+            raise RuntimeError("pipeline is closed")
+        if self._finished:
+            self.reset()
+        self._ensure_started()
+        return _EpochIterator(self)
+
+    def __next__(self):
+        return self._pull()
+
+    def _pull(self):
+        self._ensure_started()
+        try:
+            item = self._next()
+        except StopIteration:
+            self._finished = True
+            raise
+        self._cursor += 1
+        return item
+
+    def _ensure_started(self):
+        if not self._started:
+            if self._source is not None:
+                self._source._ensure_started()
+            self._start_epoch()
+            self._started = True
+
+    def reset(self) -> None:
+        """Advance to the next epoch (cascades to the source)."""
+        if self._source is not None:
+            self._source.reset()
+        self._epoch += 1
+        self._cursor = 0
+        self._finished = False
+        self._started = False
+
+    # -- resumable state ----------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Checkpointable iteration state: ``(kind, epoch, cursor)`` per
+        stage, nested through ``source``. ``cursor`` counts items THIS
+        stage delivered to its consumer — for buffered stages
+        (``prefetch``) that is deliberately less than what the stage
+        pulled from upstream, so a restore never loses the in-flight
+        items."""
+        sd: Dict[str, Any] = {"kind": self.kind, "epoch": self._epoch,
+                              "cursor": self._cursor}
+        sd.update(self._own_state())
+        if self._source is not None:
+            sd["source"] = self._source.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        """Bit-exact mid-epoch restore: rewind every stage to the start
+        of ``sd['epoch']``, re-derive per-epoch state (shuffle rng),
+        then fast-forward this stage's ``cursor`` items. Stages forward
+        the skip upstream with O(1) shortcuts where the item stream is
+        index-addressable; buffer-dependent stages (``shuffle``) replay
+        their draws, which is what makes the restored pool — and hence
+        the remaining stream — bitwise identical."""
+        self._check_state(sd)
+        self._load_epoch(sd)
+        self._ensure_started()
+        try:
+            self._skip(int(sd["cursor"]))
+        except StopIteration:
+            # a cursor landing exactly on the epoch's end (checkpoint
+            # taken after the final — possibly partial — batch): the
+            # remaining stream is empty, which is a valid resume point
+            pass
+        self._finished = False
+
+    def _check_state(self, sd: Dict[str, Any]) -> None:
+        if sd.get("kind") != self.kind:
+            raise ValueError(
+                f"state kind {sd.get('kind')!r} does not match stage "
+                f"{self.kind!r} — pipeline structure changed since "
+                "state_dict()")
+        src_sd = sd.get("source")
+        if (src_sd is None) != (self._source is None):
+            raise ValueError("pipeline depth changed since state_dict()")
+        if self._source is not None:
+            self._source._check_state(src_sd)
+
+    def _load_epoch(self, sd: Dict[str, Any]) -> None:
+        if self._source is not None:
+            self._source._load_epoch(sd["source"])
+        self._epoch = int(sd["epoch"])
+        self._cursor = 0
+        self._finished = False
+        self._started = False
+        self._load_own_state(sd)
+
+    def _own_state(self) -> Dict[str, Any]:
+        return {}
+
+    def _load_own_state(self, sd: Dict[str, Any]) -> None:
+        pass
+
+    def _skip(self, n: int) -> None:
+        """Fast-forward ``n`` items within the current epoch. Default:
+        produce and discard (always correct); stages override with
+        cheaper exact equivalents."""
+        for _ in range(n):
+            self._pull()
+        # _pull counted them; they were consumed before the checkpoint
+        # so the cursor is already right — nothing else to do
+
+    # -- teardown -----------------------------------------------------------
+    def close(self) -> None:
+        """Join every worker/producer in the chain. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._close_own()
+        if self._source is not None:
+            self._source.close()
+
+    def _close_own(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- subclass hooks -----------------------------------------------------
+    def _start_epoch(self) -> None:
+        pass
+
+    def _next(self):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+class _IterSource(Stage):
+    """Source over a factory: ``factory()`` is called once per epoch and
+    must return a fresh iterable producing the SAME item stream every
+    time it is called with the same epoch (determinism contract)."""
+
+    kind = "from_iter"
+
+    def __init__(self, factory: Callable[[], Iterable]):
+        super().__init__(None)
+        if not callable(factory):
+            raise TypeError(
+                "from_iter takes a zero-arg factory returning a fresh "
+                "iterable per epoch (a bare iterable could not be "
+                "re-wound for the next epoch or a resume)")
+        self._factory = factory
+        self._it = None
+
+    def _start_epoch(self):
+        self._it = iter(self._factory())
+
+    def _next(self):
+        return next(self._it)
+
+
+class _NDArraySource(Stage):
+    """In-memory source: emits per-sample leaves (a tuple when label or
+    multiple arrays are given). Random-access, so skip is O(1)."""
+
+    kind = "from_ndarray"
+
+    def __init__(self, data, label=None):
+        super().__init__(None)
+        arrays: List[np.ndarray] = []
+        for part in ([data] if not isinstance(data, (list, tuple))
+                     else list(data)):
+            arrays.append(_as_numpy(part))
+        if label is not None:
+            arrays.append(_as_numpy(label))
+        if not arrays:
+            raise ValueError("from_ndarray needs at least one array")
+        n = arrays[0].shape[0]
+        for a in arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError(
+                    f"leading dims differ: {[a.shape[0] for a in arrays]}")
+        self._arrays = arrays
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def _next(self):
+        if self._cursor >= self._n:
+            raise StopIteration
+        i = self._cursor
+        if len(self._arrays) == 1:
+            return self._arrays[0][i]
+        return tuple(a[i] for a in self._arrays)
+
+    def _skip(self, n: int):
+        self._cursor += n
+
+
+class _RecordIOSource(Stage):
+    """Source over a RecordIO file: emits raw record payloads
+    (``bytes``); chain ``.map(recordio.unpack)`` / a decode fn. One
+    reader per pipeline.
+
+    Resume is O(1) where the restore's skip cascade reaches this source
+    as one exact stride (chains of ``map``/``batch``/``shard`` — the
+    common decode pipeline): the first ``_skip`` after a
+    ``load_state_dict`` whose count matches the recorded cursor seeks
+    straight to the recorded byte offset instead of re-reading. Chains
+    with a buffering stage in between (``shuffle`` replay, a prefetch
+    whose queue was non-empty at checkpoint time) fall back to
+    re-reading, which is always correct."""
+
+    kind = "from_recordio"
+
+    def __init__(self, path: str):
+        super().__init__(None)
+        from ..recordio import MXRecordIO
+
+        self._path = path
+        self._reader = MXRecordIO(path, "r")
+        self._pending_seek = None       # (cursor, offset) from a restore
+        # (records_consumed, byte_offset_after_them): written as ONE
+        # tuple so a state_dict() taken from another thread (a live
+        # prefetch producer is mid-read) can never observe a torn pair
+        # — a torn pair satisfying the seek fast path would silently
+        # drop a record on resume
+        self._pos = (0, 0)
+
+    def _start_epoch(self):
+        self._reader.reset()
+        self._pos = (0, self._reader.tell())
+
+    def _next(self):
+        # any pull before the restore stride means an upstream stage is
+        # replaying from epoch start — the seek shortcut no longer applies
+        self._pending_seek = None
+        buf = self._reader.read()
+        if buf is None:
+            raise StopIteration
+        self._pos = (self._pos[0] + 1, self._reader.tell())
+        return buf
+
+    def _own_state(self):
+        cursor, offset = self._pos
+        return {"offset": offset, "cursor_snap": cursor,
+                "path": self._path}
+
+    def _load_own_state(self, sd):
+        self._pending_seek = (int(sd.get("cursor_snap", sd["cursor"])),
+                              int(sd["offset"]))
+
+    def _skip(self, n: int):
+        pending, self._pending_seek = self._pending_seek, None
+        if pending is not None and self._cursor == 0 and n == pending[0]:
+            # restore fast path: this skip IS the recorded position
+            self._reader.seek(pending[1])
+            self._cursor = n
+            self._pos = (n, pending[1])
+            return
+        for _ in range(n):
+            if self._reader.read() is None:
+                # EOF mid-stride is an end-of-epoch signal (a shard
+                # stride past the tail, or a checkpoint taken after a
+                # final partial batch), not an error
+                raise StopIteration
+            self._pos = (self._pos[0] + 1, self._reader.tell())
+            self._cursor += 1
+
+    def _close_own(self):
+        self._reader.close()
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+class _Shard(Stage):
+    kind = "shard"
+
+    def __init__(self, source: Stage, shard_index: int, shard_count: int):
+        super().__init__(source)
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(
+                f"shard_index {shard_index} not in [0, {shard_count})")
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+
+    def _next(self):
+        src = self._source
+        if self._cursor == 0:
+            src._skip(self.shard_index)
+        else:
+            src._skip(self.shard_count - 1)
+        return src._pull()
+
+    def _skip(self, n: int):
+        if n <= 0:
+            return
+        src = self._source
+        if self._cursor == 0:
+            src._skip(self.shard_index)
+        else:
+            src._skip(self.shard_count - 1)
+        # n-1 whole strides + the item itself, skipped upstream
+        src._skip((n - 1) * self.shard_count + 1)
+        self._cursor += n
+
+
+class _Batch(Stage):
+    kind = "batch"
+
+    def __init__(self, source: Stage, batch_size: int, drop_last: bool):
+        super().__init__(source)
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def _next(self):
+        items = []
+        src = self._source
+        for _ in range(self.batch_size):
+            try:
+                items.append(src._pull())
+            except StopIteration:
+                break
+        if not items or (self.drop_last and len(items) < self.batch_size):
+            raise StopIteration
+        return _stack(items)
+
+    def _skip(self, n: int):
+        # mid-epoch checkpoints sit on full-batch boundaries (a partial
+        # batch is only ever the epoch's last), so this is exact
+        self._source._skip(n * self.batch_size)
+        self._cursor += n
+
+
+class _Map(Stage):
+    kind = "map"
+
+    def __init__(self, source: Stage, fn: Callable,
+                 num_workers: Optional[int]):
+        super().__init__(source)
+        self.fn = fn
+        if num_workers is None:
+            num_workers = int(_cfg("MXTPU_DATA_WORKERS"))
+        self.num_workers = max(0, int(num_workers))
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: deque = deque()
+        # submit-ahead window: enough to keep every worker busy, small
+        # enough that a stalled consumer stalls the producers (bounded
+        # backpressure, never an unbounded futures list)
+        self._window = 2 * self.num_workers
+
+    def _start_epoch(self):
+        self._pending.clear()
+        self._exhausted = False
+
+    def _next(self):
+        if self.num_workers == 0:
+            return self.fn(self._source._pull())
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                thread_name_prefix="mxtpu-data-map")
+        while not self._exhausted and len(self._pending) < self._window:
+            try:
+                item = self._source._pull()
+            except StopIteration:
+                self._exhausted = True
+                break
+            self._pending.append(self._pool.submit(self.fn, item))
+        if not self._pending:
+            raise StopIteration
+        # .result() re-raises a worker exception at the consumer — a
+        # raising map fn can never strand the pipeline
+        return self._pending.popleft().result()
+
+    def _skip(self, n: int):
+        # fn is applied per item with no cross-item state (documented
+        # determinism contract), so skipping skips the work too. Items
+        # already submitted ahead into the worker pool are the NEXT n
+        # in stream order — discard those futures first, else a
+        # downstream shard's stride skip would land past the
+        # submit-ahead window and deliver mis-sharded items
+        left = n
+        while left > 0 and self._pending:
+            self._pending.popleft().cancel()
+            left -= 1
+        if left:
+            self._source._skip(left)
+        self._cursor += n
+
+    def _close_own(self):
+        for f in self._pending:
+            f.cancel()
+        self._pending.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+
+class _Shuffle(Stage):
+    kind = "shuffle"
+
+    def __init__(self, source: Stage, buffer_size: Optional[int],
+                 seed: int):
+        super().__init__(source)
+        if buffer_size is None:
+            buffer_size = int(_cfg("MXTPU_DATA_SHUFFLE_BUFFER"))
+        self.buffer_size = max(1, int(buffer_size))
+        self.seed = int(seed)
+        self._pool: List[Any] = []
+        self._rng = None
+
+    def _start_epoch(self):
+        # fresh order every epoch, reproducible from (seed, epoch) —
+        # the resumable analog of NDArrayIter(shuffle=True, seed=...)
+        self._rng = np.random.default_rng((self.seed, self._epoch))
+        self._pool = []
+        self._exhausted = False
+
+    def _next(self):
+        src = self._source
+        while not self._exhausted and len(self._pool) < self.buffer_size:
+            try:
+                self._pool.append(src._pull())
+            except StopIteration:
+                self._exhausted = True
+        if not self._pool:
+            raise StopIteration
+        i = int(self._rng.integers(len(self._pool)))
+        self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+        return self._pool.pop()
+
+    # no _skip override: the pool contents depend on the draw history,
+    # so restore replays the draws (default produce-and-discard) — the
+    # only generic way to rebuild the pool bit-exactly
+
+    def _skip(self, n: int):
+        for _ in range(n):
+            self._next()
+        self._cursor += n
+
+
+class _Prefetch(Stage):
+    """Background producer filling a bounded queue; the decoupling stage
+    that lets host ETL run ahead of (and overlap) the consumer."""
+
+    kind = "prefetch"
+
+    def __init__(self, source: Stage, depth: Optional[int],
+                 name: Optional[str] = None):
+        super().__init__(source)
+        if depth is None:
+            depth = int(_cfg("MXTPU_DATA_HOST_PREFETCH"))
+        self.depth = max(1, int(depth))
+        self.name = name or "prefetch"
+        self._producer: Optional[_QueueProducer] = None
+        self._insts = None
+
+    def _instruments(self):
+        if self._insts is None:
+            self._insts = _data_instruments(self.name)
+        return self._insts
+
+    def _start_epoch(self):
+        self._join_producer()
+        self._producer = _QueueProducer(
+            self._source._pull, self.depth, self._instruments(),
+            name="mxtpu-data-prefetch")
+
+    def _next(self):
+        if self._producer is None:
+            # epoch already ended (or error consumed): keep raising,
+            # never block on a dead queue
+            raise StopIteration
+        ok, item, _ = self._producer.get()
+        if not ok:
+            self._join_producer()
+            raise item
+        if item is _QueueProducer.DONE:
+            self._join_producer()
+            raise StopIteration
+        return item
+
+    def queue_depth(self) -> int:
+        """Items currently staged (tests/benchmarks poll this)."""
+        return self._producer.qsize() if self._producer is not None else 0
+
+    def _skip(self, n: int):
+        # restore path: the producer isn't running yet (load resets the
+        # chain), so skip straight through to the source — the items a
+        # live producer had in flight at checkpoint time were not
+        # consumed, and cursor-based restore re-produces them
+        if self._producer is not None:
+            for _ in range(n):
+                self._next()
+        else:
+            self._source._skip(n)
+        self._cursor += n
+
+    def _load_epoch(self, sd):
+        self._join_producer()
+        super()._load_epoch(sd)
+
+    def load_state_dict(self, sd):
+        self._check_state(sd)
+        self._load_epoch(sd)
+        # fast-forward BEFORE starting the producer so the skip runs
+        # synchronously against the source; a cursor that lands exactly
+        # on the epoch's end is fine (remaining stream is empty)
+        if self._source is not None:
+            self._source._ensure_started()
+        try:
+            self._source._skip(int(sd["cursor"]))
+        except StopIteration:
+            pass
+        self._cursor = int(sd["cursor"])
+        self._start_epoch()
+        self._started = True
+        self._finished = False
+
+    def reset(self):
+        self._join_producer()
+        super().reset()
+
+    def _join_producer(self):
+        if self._producer is not None:
+            self._producer.join()
+            self._producer = None
+
+    def _close_own(self):
+        self._join_producer()
+
+
+# ---------------------------------------------------------------------------
+# helpers + constructors
+# ---------------------------------------------------------------------------
+def _as_numpy(x) -> np.ndarray:
+    from ..ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def _stack(items: Sequence):
+    """Stack a list of samples leaf-wise: tuples/lists stack per
+    position, arrays/scalars via np.stack."""
+    first = items[0]
+    if isinstance(first, (tuple, list)):
+        cols = zip(*items)
+        out = [_stack(list(c)) for c in cols]
+        return tuple(out) if isinstance(first, tuple) else out
+    if isinstance(first, dict):
+        return {k: _stack([it[k] for it in items]) for k in first}
+    return np.stack([np.asarray(it) for it in items])
+
+
+def from_iter(factory: Callable[[], Iterable]) -> Stage:
+    """Pipeline source from a zero-arg factory returning a fresh
+    iterable per epoch (must be deterministic for resumability)."""
+    return _IterSource(factory)
+
+
+def from_ndarray(data, label=None) -> Stage:
+    """Pipeline source over in-memory arrays (np.ndarray / NDArray, or a
+    list of them): emits per-sample items — ``data_i``, or a tuple
+    ``(data_i, ..., label_i)`` when several arrays are given."""
+    return _NDArraySource(data, label)
+
+
+def from_recordio(path: str) -> Stage:
+    """Pipeline source over a RecordIO file: emits raw record payloads
+    (``bytes``); chain ``.map()`` with ``recordio.unpack``/a decoder."""
+    return _RecordIOSource(path)

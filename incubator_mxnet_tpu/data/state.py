@@ -1,0 +1,65 @@
+"""Checkpointable iteration state for ``mxtpu.data`` pipelines.
+
+The resume contract (docs/DATA.md "Resumable iteration"): every stage
+exposes ``state_dict()`` / ``load_state_dict()`` with ``(epoch, cursor)``
+per stage; because every stage is deterministic given its static config
+(seeds) and that state, a restore re-derives the epoch's stream and
+fast-forwards — the remaining batch stream is **bit-identical** to the
+one the checkpoint interrupted (asserted across shuffle + shard +
+prefetch in ``tests/test_data_pipeline.py``).
+
+This module is the serialization shim between that protocol and the
+sharded-checkpoint layer (``parallel/checkpoint.py``): pipeline state is
+small plain JSON (ints and strings — shuffle order comes from
+``(seed, epoch)``-derived rngs, so no bit-generator blobs), written as a
+per-process sidecar next to the tensor shards, because each process owns
+a different shard of the input stream.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+__all__ = ["iterator_state", "load_iterator_state",
+           "save_iterator_state_file", "load_iterator_state_file"]
+
+_MAGIC = "MXTPU-DATA-1"
+
+
+def iterator_state(it) -> Dict[str, Any]:
+    """``it.state_dict()`` wrapped with a format tag (``it`` is a
+    pipeline Stage, a :class:`~.device_prefetch.DevicePrefetcher`, or
+    anything exposing ``state_dict``)."""
+    sd = it.state_dict()
+    return {"magic": _MAGIC, "state": sd}
+
+
+def load_iterator_state(it, payload: Dict[str, Any]) -> None:
+    """Inverse of :func:`iterator_state`."""
+    if payload.get("magic") != _MAGIC:
+        raise ValueError(f"not a {_MAGIC} iterator state")
+    it.load_state_dict(payload["state"])
+
+
+def save_iterator_state_file(path: str, it) -> str:
+    """Write ``it``'s iteration state as JSON; returns ``path``."""
+    with open(path, "w") as f:
+        json.dump(iterator_state(it), f, indent=1, default=_jsonable)
+    return path
+
+
+def load_iterator_state_file(path: str, it) -> None:
+    """Restore ``it`` from a :func:`save_iterator_state_file` file."""
+    with open(path) as f:
+        load_iterator_state(it, json.load(f))
+
+
+def _jsonable(obj):
+    """np ints/floats sneak into cursors on some paths; store plainly."""
+    for cast in (int, float):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return str(obj)

@@ -397,6 +397,18 @@ class Trainer:
         self._fused.shard_update = bool(shard_update)
         return self
 
+    def device_prefetcher(self, source, depth: Optional[int] = None):
+        """The preferred feed for a ``Trainer``/``FusedStep`` training
+        loop (docs/DATA.md): wrap a ``mxtpu.data`` pipeline (or any
+        re-iterable of batches) in a DevicePrefetcher with default-device
+        placement, so the forward pass consumes device-resident batches
+        and host ETL overlaps the fused step. The FusedStep O(1)-dispatch
+        guarantee is unaffected (tests/test_data_pipeline.py)."""
+        from ..data import DevicePrefetcher
+
+        return DevicePrefetcher(source, sharding=None, depth=depth,
+                                site="trainer.data")
+
     # -- stepping -----------------------------------------------------------
     def step(self, batch_size: int, ignore_stale_grad: bool = False):
         """Rescale by 1/batch_size, allreduce (if distributed), update —

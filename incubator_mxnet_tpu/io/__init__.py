@@ -111,7 +111,7 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None, rng=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False,
                                default_name=data_name)
@@ -119,6 +119,15 @@ class NDArrayIter(DataIter):
                                 default_name=label_name)
         self.num_data = self.data[0][1].shape[0]
         self.shuffle = shuffle
+        # per-iterator Generator (seed=/rng=) so shuffled epochs are
+        # reproducible and resumable; unseeded keeps the legacy global
+        # np.random (MXNET_TEST_SEED-style process seeding still works)
+        if rng is not None:
+            self._shuffle_rng = rng
+        elif seed is not None:
+            self._shuffle_rng = np.random.default_rng(seed)
+        else:
+            self._shuffle_rng = np.random
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
         self._cache_idx = None
@@ -146,7 +155,7 @@ class NDArrayIter(DataIter):
             self.label = [(k, np.roll(v, leftover, axis=0))
                           for k, v in self.label]
         if self.shuffle:
-            idx = np.random.permutation(self.num_data)
+            idx = self._shuffle_rng.permutation(self.num_data)
             self.data = [(k, v[idx]) for k, v in self.data]
             self.label = [(k, v[idx]) for k, v in self.label]
         self.cursor = -self.batch_size
@@ -238,13 +247,21 @@ class ResizeIter(DataIter):
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference ``mx.io.PrefetchingIter`` over
     dmlc ThreadedIter). PJRT transfers are async already; this hides host
-    numpy work."""
+    numpy work.
+
+    **Legacy path** — kept for MXNet-parity scripts. New code should use
+    the ``mxtpu.data`` pipeline subsystem instead
+    (``data.from_iter(...).prefetch(depth)`` /
+    ``data.DevicePrefetcher``, docs/DATA.md): bounded queues with
+    backpressure, worker-exception propagation, resumable state, and
+    ``mxtpu_data_*`` telemetry."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         iters = iters if isinstance(iters, list) else [iters]
         super().__init__(iters[0].batch_size)
         self.iters = iters
         self._batch: Optional[List[DataBatch]] = None
+        self._error: Optional[BaseException] = None
         self._data_ready = threading.Event()
         self._data_taken = threading.Event()
         self._data_taken.set()
@@ -260,6 +277,12 @@ class PrefetchingIter(DataIter):
                     self_._batch = [i.next() for i in self_.iters]
                 except StopIteration:
                     self_._batch = None
+                except BaseException as e:
+                    # a dying worker must surface at the consumer, not
+                    # leave _data_ready unset forever (iter_next()/
+                    # reset() would hang)
+                    self_._batch = None
+                    self_._error = e
                 self_._data_taken.clear()
                 self_._data_ready.set()
 
@@ -276,6 +299,7 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._data_ready.wait()
+        self._error = None
         for i in self.iters:
             i.reset()
         self._data_ready.clear()
@@ -283,6 +307,11 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self) -> bool:
         self._data_ready.wait()
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._data_ready.clear()
+            self._data_taken.set()
+            raise err
         if self._batch is None:
             return False
         self.current_batch = self._batch[0] if len(self._batch) == 1 else \
@@ -303,9 +332,17 @@ class PrefetchingIter(DataIter):
     def getlabel(self):
         return self.current_batch.label
 
-    def __del__(self):
+    def close(self):
+        """Stop and join the prefetch thread. Idempotent; call from
+        tests/teardown instead of relying on ``__del__``."""
         self._started = False
         self._data_taken.set()
+        t = getattr(self, "_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def __del__(self):
+        self.close()
 
 
 class CSVIter(DataIter):
@@ -397,14 +434,44 @@ class ImageRecordIter(DataIter):
                                (batch_size,) if label_width == 1
                                else (batch_size, label_width))]
         self._record_pos = 0
+        # prefetch_buffer rides the mxtpu.data bounded pool: a background
+        # producer stages raw records in a backpressured queue (worker
+        # exceptions propagate; close() joins) — replacing the ad-hoc
+        # event-pair threading the legacy PrefetchingIter used
+        self._record_stage = None
+        if self._prefetch and self._prefetch > 0:
+            from ..data import pipeline as _data_pipeline
+
+            self._record_stage = _data_pipeline.from_iter(
+                lambda: iter(self._read_record, None)).prefetch(
+                    self._prefetch)
 
     def reset(self):
+        if self._record_stage is not None:
+            self._record_stage.reset()      # joins the producer first
         if self._native is not None:
             self._native.reset()
         else:
             self._fallback.reset()
         self._record_pos = 0
         self._pool = []
+
+    def close(self):
+        """Join the record-prefetch producer and release the reader."""
+        if self._record_stage is not None:
+            self._record_stage.close()
+        if self._native is None and hasattr(self, "_fallback"):
+            self._fallback.close()
+
+    def _pull_record(self):
+        """Next raw record through the bounded prefetch pool (or straight
+        from the reader when prefetch_buffer=0)."""
+        if self._record_stage is None:
+            return self._read_record()
+        try:
+            return self._record_stage._pull()
+        except StopIteration:
+            return None
 
     def _read_record(self):
         while True:
@@ -423,10 +490,10 @@ class ImageRecordIter(DataIter):
         """One raw record honoring the shuffle buffer (streaming shuffle
         like the reference's shuffle_chunk pool)."""
         if not self._shuffle:
-            return self._read_record()
+            return self._pull_record()
         # fill the pool
         while len(self._pool) < self._pool_target:
-            buf = self._read_record()
+            buf = self._pull_record()
             if buf is None:
                 break
             self._pool.append(buf)

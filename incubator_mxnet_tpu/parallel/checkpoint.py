@@ -87,11 +87,23 @@ def _flatten_state(params: Dict[str, Any], opt_state, frozen) -> Dict[str, Any]:
     return flat
 
 
-def save_sharded(prefix: str, trainer) -> str:
+def save_sharded(prefix: str, trainer, data_iter=None) -> str:
     """Write the trainer's params + frozen (aux) + optimizer state as a
     sharded checkpoint. Every process participates; rank 0 writes the
-    manifest."""
+    manifest.
+
+    ``data_iter`` (optional): a resumable ``mxtpu.data`` pipeline /
+    ``DevicePrefetcher`` whose iteration state (epoch, cursor, shuffle
+    seeds — docs/DATA.md "Resumable iteration") is written as a
+    per-process ``{prefix}.data-{rank}.json`` sidecar. Per process, not
+    rank 0, because each process owns a different shard of the input
+    stream; restore with the same pipeline structure on the same rank
+    resumes the batch stream bit-exactly mid-epoch."""
     rank = jax.process_index()
+    if data_iter is not None:
+        from ..data.state import save_iterator_state_file
+
+        save_iterator_state_file(f"{prefix}.data-{rank}.json", data_iter)
     flat = _flatten_state(trainer.params, trainer.opt_state, trainer.frozen)
 
     manifest = {"magic": _MAGIC, "tensors": {},
@@ -155,9 +167,14 @@ def save_sharded(prefix: str, trainer) -> str:
     return f"{prefix}.manifest.json"
 
 
-def restore_sharded(prefix: str, trainer) -> None:
+def restore_sharded(prefix: str, trainer, data_iter=None) -> None:
     """Restore params/frozen/opt_state in place, preserving shardings on
-    the trainer's current mesh."""
+    the trainer's current mesh. ``data_iter`` (optional): restore the
+    input pipeline's iteration state from this rank's
+    ``{prefix}.data-{rank}.json`` sidecar (see :func:`save_sharded`) —
+    applied LAST, after the manifest validates and the tensors restore,
+    so a failed/corrupt restore never leaves a live pipeline rewound
+    while the trainer kept its old state."""
     with open(f"{prefix}.manifest.json") as f:
         manifest = json.load(f)
     if manifest.get("magic") != _MAGIC:
@@ -214,3 +231,9 @@ def restore_sharded(prefix: str, trainer) -> None:
     trainer.params = new_params
     trainer.frozen = new_frozen
     trainer.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    if data_iter is not None:
+        from ..data.state import load_iterator_state_file
+
+        load_iterator_state_file(
+            f"{prefix}.data-{jax.process_index()}.json", data_iter)

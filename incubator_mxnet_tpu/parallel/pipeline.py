@@ -659,6 +659,16 @@ class PipelineTrainer:
         return jax.jit(step,
                        donate_argnums=(0, 1, 2) if self._donate else ())
 
+    def device_prefetcher(self, source, depth: Optional[int] = None):
+        """The preferred feed for :meth:`step` (docs/DATA.md): stages
+        upcoming batches on the mesh with this trainer's microbatch
+        layout (data-axis sharded when the mesh has one, replicated
+        otherwise) so the H2D transfer overlaps the pipelined step."""
+        from ..data import DevicePrefetcher
+
+        return DevicePrefetcher(source, sharding=self._batch_sharding,
+                                depth=depth, site="pipeline.data")
+
     def step(self, data, labels) -> float:
         x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         y = labels._data if isinstance(labels, NDArray) else \

@@ -276,9 +276,29 @@ class SPMDTrainer:
             return x._data
         return jnp.asarray(x)
 
+    def device_prefetcher(self, source, depth: Optional[int] = None):
+        """The preferred feed for :meth:`step` (docs/DATA.md): wrap a
+        ``mxtpu.data`` pipeline (or any re-iterable of ``(data, labels)``
+        batches) in a :class:`~..data.DevicePrefetcher` that stages the
+        next batches on the mesh with THIS trainer's batch sharding, so
+        the H2D transfer overlaps the running step and ``step``'s own
+        ``device_put`` becomes a no-op::
+
+            feed = st.device_prefetcher(pipe)
+            for x, y in feed:
+                loss = st.step(x, y)
+        """
+        from ..data import DevicePrefetcher
+
+        return DevicePrefetcher(source, sharding=self._batch_sharding,
+                                depth=depth, site="spmd.data")
+
     def step(self, data, labels) -> float:
         """One fused forward+backward+update step. ``data``/``labels`` may be
-        a single array or a list; they are sharded along the data axis."""
+        a single array or a list; they are sharded along the data axis.
+        Batches staged by :meth:`device_prefetcher` are already resident
+        with the right sharding — the ``device_put`` below is then a
+        no-op and the step never blocks on the feed."""
         data = data if isinstance(data, (list, tuple)) else [data]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         data_arrays = [jax.device_put(self._as_jax(d), self._batch_sharding)

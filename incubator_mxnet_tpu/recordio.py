@@ -72,6 +72,14 @@ class MXRecordIO:
     def tell(self) -> int:
         return self.handle.tell()
 
+    def seek(self, pos: int) -> None:
+        """Reposition the sequential reader to a byte offset previously
+        returned by :meth:`tell` (O(1) resume for ``mxtpu.data``'s
+        ``from_recordio`` source; reads from anywhere else mid-record
+        raise the magic check)."""
+        assert not self.writable
+        self.handle.seek(pos)
+
     def write(self, buf: bytes):
         assert self.writable
         # dmlc lrecord: upper 3 bits = continuation kind (0 for whole

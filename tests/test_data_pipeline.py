@@ -1,0 +1,654 @@
+"""mxtpu.data tests: pipeline stages, seeded shuffle/shard determinism,
+bit-exact mid-epoch resume across shuffle+shard+prefetch (ISSUE-5
+acceptance), DevicePrefetcher overlap + O(1)-dispatch preservation,
+worker-exception propagation / close() robustness, the io/ satellite
+fixes (PrefetchingIter deadlock, NDArrayIter seed, last_batch_handle
+edge cases, ImageRecordIter bounded-pool prefetch), and the sharded
+checkpoint data-state sidecar."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import data, gluon, io as mio, parallel, recordio
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _xy(n=24, dim=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, dim).astype(np.float32),
+            np.arange(n).astype(np.float32))
+
+
+def _labels(batches):
+    return [np.asarray(b[-1]).tolist() for b in batches]
+
+
+# ---------------------------------------------------------------------------
+# pipeline basics
+# ---------------------------------------------------------------------------
+def test_from_ndarray_batch_map_epochs():
+    x, y = _xy(10, 2)
+    pipe = data.from_ndarray(x, y).batch(4).map(
+        lambda b: (b[0] * 2, b[1]))
+    ep = list(pipe)
+    assert len(ep) == 3                      # 4+4+2
+    np.testing.assert_allclose(ep[0][0], x[:4] * 2)
+    np.testing.assert_array_equal(ep[2][1], y[8:])
+    # next epoch: same content (no shuffle)
+    ep2 = list(pipe)
+    assert _labels(ep) == _labels(ep2)
+    pipe.close()
+
+
+def test_batch_drop_last():
+    x, y = _xy(10, 2)
+    assert len(list(data.from_ndarray(x, y).batch(4, drop_last=True))) == 2
+
+
+def test_shuffle_seeded_reproducible_and_fresh_per_epoch():
+    x, y = _xy(32, 2)
+
+    def build(seed):
+        return data.from_ndarray(x, y).shuffle(buffer_size=8, seed=seed)
+
+    a0 = _labels([(i,) if not isinstance(i, tuple) else i
+                  for i in build(5)])
+    b0 = _labels([i for i in build(5)])
+    assert a0 == b0                          # same seed, same order
+    assert a0 != _labels([i for i in build(6)])   # different seed
+    p = build(5)
+    e0, e1 = _labels(list(p)), _labels(list(p))
+    assert sorted(e0) == sorted(e1)
+    assert e0 != e1                          # fresh order per epoch
+    # every sample exactly once
+    assert sorted(e0) == np.arange(32).tolist()
+
+
+def test_shard_downstream_of_worker_map_correct():
+    """Regression: a shard stride skipping through a worker-pooled map
+    must discard the pre-submitted futures, not deliver them."""
+    x = np.arange(20).astype(np.float32)
+    for i in range(2):
+        with data.from_ndarray(x).map(
+                lambda v: v, num_workers=2).shard(i, 2) as pipe:
+            got = [float(v) for v in pipe]
+            assert got == list(range(i, 20, 2)), got
+
+
+def test_shard_disjoint_cover():
+    x, y = _xy(21, 2)
+    seen = []
+    for i in range(3):
+        part = _labels(list(data.from_ndarray(x, y).shard(i, 3)))
+        seen.extend(part)
+        assert part == np.arange(i, 21, 3).tolist()
+    assert sorted(seen) == np.arange(21).tolist()
+
+
+def test_map_workers_ordered_and_equal_to_serial():
+    x, y = _xy(40, 2)
+
+    def fn(item):
+        d, l = item
+        time.sleep(0.001 * (int(l) % 3))     # jitter completion order
+        return d + 1, l
+
+    serial = _labels(list(data.from_ndarray(x, y).map(fn)))
+    with data.from_ndarray(x, y).map(fn, num_workers=4) as pipe:
+        pooled = _labels(list(pipe))
+    assert pooled == serial                  # ordered despite jitter
+
+
+# ---------------------------------------------------------------------------
+# bit-exact mid-epoch resume (acceptance criterion)
+# ---------------------------------------------------------------------------
+def _resume_pipe(seed=3):
+    x, y = _xy(64, 4, seed=1)
+    return (data.from_ndarray(x, y).shuffle(buffer_size=16, seed=seed)
+            .shard(1, 2).batch(4).prefetch(2))
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ba[0]), np.asarray(bb[0]))
+        np.testing.assert_array_equal(np.asarray(ba[1]), np.asarray(bb[1]))
+
+
+@pytest.mark.parametrize("consume", [0, 3, 7])
+def test_resume_shuffle_shard_prefetch_bit_exact(consume):
+    """state_dict mid-epoch on a seeded+shuffled+sharded+prefetched
+    pipeline restores a bit-identical remaining batch stream."""
+    pipe = _resume_pipe()
+    it = iter(pipe)
+    for _ in range(consume):
+        next(it)
+    sd = pipe.state_dict()
+    rest_a = list(it)
+
+    pipe2 = _resume_pipe()
+    pipe2.load_state_dict(sd)
+    rest_b = list(iter(pipe2))
+    _assert_streams_equal(rest_a, rest_b)
+    pipe.close()
+    pipe2.close()
+
+
+def test_resume_across_epoch_boundary():
+    """Resume taken in epoch 1 restores epoch 1's shuffle order (not
+    epoch 0's) and continues through epoch 2 identically."""
+    pipe = _resume_pipe(seed=9)
+    list(pipe)                               # epoch 0
+    it = iter(pipe)                          # epoch 1
+    for _ in range(2):
+        next(it)
+    sd = pipe.state_dict()
+    rest_a = list(it) + list(pipe)           # rest of epoch 1 + epoch 2
+
+    pipe2 = _resume_pipe(seed=9)
+    pipe2.load_state_dict(sd)
+    rest_b = list(iter(pipe2)) + list(pipe2)
+    _assert_streams_equal(rest_a, rest_b)
+    pipe.close()
+    pipe2.close()
+
+
+def test_resume_rejects_changed_structure():
+    pipe = _resume_pipe()
+    sd = pipe.state_dict()
+    other = data.from_ndarray(*_xy(64, 4, seed=1)).batch(4)
+    with pytest.raises(ValueError):
+        other.load_state_dict(sd)
+    pipe.close()
+    other.close()
+
+
+def test_device_prefetcher_next_after_epoch_raises_not_hangs():
+    """Regression: a bare next(feed) after the epoch ended must keep
+    raising StopIteration, not block forever on the dead queue."""
+    x, y = _xy(8, 2)
+    feed = data.DevicePrefetcher(data.from_ndarray(x, y).batch(4),
+                                 depth=2, site="t.done")
+    assert len(list(feed)) == 2
+    with pytest.raises(StopIteration):
+        next(feed)                           # returned within one step
+    # explicit re-iteration starts the next epoch
+    assert len(list(feed)) == 2
+    feed.close()
+
+
+def test_recordio_shard_terminates_at_epoch_end(tmp_path):
+    """Regression: a shard stride hitting EOF is end-of-epoch, not a
+    ValueError (10 records, 4 shards -> strides overrun the tail)."""
+    path = str(tmp_path / "s.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        w.write(f"rec-{i}".encode())
+    w.close()
+    for i in range(4):
+        with data.from_recordio(path).shard(i, 4) as pipe:
+            got = list(pipe)
+            assert got == [f"rec-{j}".encode() for j in range(i, 10, 4)]
+            assert list(pipe)[0] == got[0]   # next epoch restarts cleanly
+
+
+def test_recordio_composed_resume_uses_seek(tmp_path, monkeypatch):
+    """The O(1) byte-offset restore engages through a composed
+    map+batch chain: the skip cascade reaches the source as one exact
+    stride and seeks instead of re-reading every record."""
+    path = str(tmp_path / "c.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(40):
+        w.write(f"rec-{i:02d}".encode())
+    w.close()
+
+    def build():
+        return data.from_recordio(path).map(bytes.decode).batch(5)
+
+    pipe = build()
+    it = iter(pipe)
+    consumed = [next(it) for _ in range(6)]
+    sd = pipe.state_dict()
+    rest_a = list(it)
+
+    reads = {"n": 0}
+    orig_read = recordio.MXRecordIO.read
+
+    def counting_read(self):
+        reads["n"] += 1
+        return orig_read(self)
+
+    monkeypatch.setattr(recordio.MXRecordIO, "read", counting_read)
+    pipe2 = build()
+    pipe2.load_state_dict(sd)
+    restore_reads = reads["n"]
+    rest_b = list(iter(pipe2))
+    assert len(rest_a) == len(rest_b)
+    for a, b in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(a, b)
+    assert restore_reads == 0, \
+        f"restore re-read {restore_reads} records instead of seeking"
+    pipe.close()
+    pipe2.close()
+
+
+def test_recordio_source_offset_resume(tmp_path):
+    path = str(tmp_path / "r.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [f"rec-{i}".encode() for i in range(9)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    pipe = data.from_recordio(path)
+    it = iter(pipe)
+    assert [next(it) for _ in range(4)] == payloads[:4]
+    sd = pipe.state_dict()
+    assert sd["offset"] > 0                  # O(1) byte-offset restore
+    # (cursor, offset) are snapshotted as one pair: the offset must
+    # correspond exactly to cursor_snap records consumed
+    assert sd["cursor_snap"] == sd["cursor"] == 4
+    pipe2 = data.from_recordio(path)
+    pipe2.load_state_dict(sd)
+    assert list(iter(pipe2)) == payloads[4:]
+    pipe.close()
+    pipe2.close()
+
+
+def test_restore_sharded_validates_before_touching_data_iter(tmp_path):
+    """Regression: a failed restore (bad prefix) must not leave the
+    pipeline rewound while the trainer kept its old state."""
+    x, y = _xy(16, 3)
+    pipe = data.from_ndarray(x, y).batch(4)
+    it = iter(pipe)
+    next(it)
+    with pytest.raises(OSError):
+        parallel.restore_sharded(str(tmp_path / "nope"), object(),
+                                 data_iter=pipe)
+    # pipeline untouched: continues from batch 1
+    np.testing.assert_array_equal(np.asarray(next(it)[1]), y[4:8])
+    pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# robustness (acceptance criterion)
+# ---------------------------------------------------------------------------
+def test_raising_map_fn_surfaces_at_consumer():
+    x, y = _xy(16, 2)
+
+    def bad(item):
+        raise RuntimeError("etl boom")
+
+    pipe = data.from_ndarray(x, y).map(bad, num_workers=2).prefetch(2)
+    with pytest.raises(RuntimeError, match="etl boom"):
+        next(iter(pipe))
+    pipe.close()
+
+
+def test_raising_source_surfaces_at_consumer():
+    def factory():
+        yield 1
+        raise ValueError("source boom")
+
+    pipe = data.from_iter(factory).prefetch(2)
+    it = iter(pipe)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="source boom"):
+        next(it)
+    pipe.close()
+
+
+def test_close_joins_workers():
+    import threading
+
+    x, y = _xy(64, 2)
+    pipe = data.from_ndarray(x, y).map(
+        lambda b: b, num_workers=2).prefetch(2)
+    next(iter(pipe))                         # spin everything up
+    pipe.close()
+    assert not any(t.name.startswith("mxtpu-data")
+                   for t in threading.enumerate() if t.is_alive())
+    with pytest.raises(RuntimeError):
+        iter(pipe)                           # closed pipelines say so
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher: overlap + integration (acceptance criteria)
+# ---------------------------------------------------------------------------
+def _spmd_trainer(batch, dim):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(dim, activation="relu"),
+            nn.Dense(dim, activation="relu"), nn.Dense(10))
+    net.initialize(init="xavier")
+    net(mx.nd.zeros((2, dim)))
+    mesh = parallel.make_mesh({"data": -1})
+    return parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh)
+
+
+def _slow_pipe(n_batches, batch, dim, item_ms, workers=0):
+    rng = np.random.RandomState(0)
+    xs = rng.rand(n_batches * batch, dim).astype(np.float32)
+    ys = rng.randint(0, 10, (n_batches * batch,)).astype(np.float32)
+
+    def etl(b):
+        time.sleep(item_ms / 1e3)
+        return b
+
+    return data.from_ndarray(xs, ys).batch(batch).map(
+        etl, num_workers=workers)
+
+
+@pytest.mark.slow
+def test_device_prefetcher_overlaps_slow_source():
+    """With a synthetic slow host source the prefetched feed keeps its
+    queue non-empty during steps and beats the synchronous feed on
+    wall-time/step (CPU overlap proof): naive inline-ETL feed vs the
+    subsystem — the same ETL on the bounded worker pool behind a
+    DevicePrefetcher. The loop fetches the loss each step (the
+    realistic metrics fence)."""
+    import jax
+
+    batch, dim, item_ms, steps = 512, 512, 60.0, 6
+    trainer = _spmd_trainer(batch, dim)
+
+    def run(prefetch):
+        src = _slow_pipe(steps + 3, batch, dim, item_ms,
+                         workers=4 if prefetch else 0)
+        feed = trainer.device_prefetcher(src, depth=2) if prefetch \
+            else src
+        it = iter(feed)
+        x, y = next(it)                      # compile outside the window
+        float(jax.device_get(trainer.step(x, y)))
+        depths = []
+        t0 = time.perf_counter()
+        done = 0
+        for x, y in it:
+            loss = trainer.step(x, y)
+            float(jax.device_get(loss))      # per-step metrics fence
+            if prefetch:
+                depths.append(feed.queue_depth())
+            done += 1
+            if done >= steps:
+                break
+        per = (time.perf_counter() - t0) / done
+        if prefetch:
+            feed.close()
+        else:
+            src.close()
+        return per, depths
+
+    sync_per, _ = run(prefetch=False)
+    pre_per, depths = run(prefetch=True)
+    # steady state: the producer (10 ms ETL) outruns the ~25 ms step,
+    # so batches are always staged ahead
+    assert all(d > 0 for d in depths[1:]), depths
+    assert pre_per < sync_per * 0.9, (pre_per, sync_per)
+
+
+def test_device_prefetcher_places_with_trainer_sharding():
+    import jax
+
+    batch, dim = 16, 8
+    trainer = _spmd_trainer(batch, dim)
+    xs, ys = _xy(48, dim)
+    pipe = data.from_ndarray(xs, ys % 10).batch(batch)
+    feed = trainer.device_prefetcher(pipe, depth=2)
+    x, y = next(iter(feed))
+    assert isinstance(x, jax.Array)
+    assert x.sharding == trainer._batch_sharding
+    loss = trainer.step(x, y)
+    assert np.isfinite(float(jax.device_get(loss)))
+    feed.close()
+
+
+def test_fused_step_o1_dispatch_with_prefetcher():
+    """The FusedStep O(1)-dispatch guarantee holds with the
+    DevicePrefetcher engaged as the feed."""
+    from tests.test_fused_step import _make_params, _set_grads
+
+    n_params, steps = 20, 3
+    params = _make_params(n_params, seed=4, shape=(6,))
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    pipe = data.from_ndarray(*_xy(steps * 4, 6)).batch(4)
+    feed = trainer.device_prefetcher(pipe, depth=2)
+    done = 0
+    for _x, _y in feed:
+        _set_grads(params, 50 + done)
+        trainer.step(4)
+        done += 1
+        if done >= steps:
+            break
+    feed.close()
+    assert done == steps
+    assert trainer._fused.dispatch_count == steps
+    assert len(trainer._fused._cache) == 1
+
+
+def test_device_prefetcher_resume_delivered_only():
+    """The prefetcher's state rewinds to DELIVERED batches: staged but
+    unconsumed batches reappear after restore."""
+    x, y = _xy(32, 3)
+    feed = data.DevicePrefetcher(data.from_ndarray(x, y).batch(4),
+                                 depth=3, site="t.resume")
+    it = iter(feed)
+    a = [next(it), next(it)]
+    time.sleep(0.05)                         # let the producer run ahead
+    sd = feed.state_dict()
+    assert sd["cursor"] == 2
+    rest_a = list(it)
+
+    feed2 = data.DevicePrefetcher(data.from_ndarray(x, y).batch(4),
+                                  depth=3, site="t.resume2")
+    feed2.load_state_dict(sd)
+    rest_b = list(feed2)
+    _assert_streams_equal(rest_a, rest_b)
+    assert feed2.state_dict()["cursor"] == 8
+    feed.close()
+    feed2.close()
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoint sidecar
+# ---------------------------------------------------------------------------
+def test_sharded_checkpoint_with_data_state(tmp_path):
+    batch, dim = 8, 4
+    trainer = _spmd_trainer(batch, dim)
+    x, y = _xy(64, dim, seed=2)
+    y = y % 10
+
+    def build():
+        return (data.from_ndarray(x, y).shuffle(buffer_size=16, seed=7)
+                .batch(batch).prefetch(2))
+
+    pipe = build()
+    it = iter(pipe)
+    for _ in range(3):
+        xb, yb = next(it)
+        trainer.step(xb, yb)
+    prefix = str(tmp_path / "ck")
+    parallel.save_sharded(prefix, trainer, data_iter=pipe)
+    assert os.path.exists(prefix + ".data-0.json")
+    with open(prefix + ".data-0.json") as f:
+        payload = json.load(f)
+    assert payload["magic"] == "MXTPU-DATA-1"
+    rest_a = list(it)
+
+    trainer2 = _spmd_trainer(batch, dim)
+    pipe2 = build()
+    parallel.restore_sharded(prefix, trainer2, data_iter=pipe2)
+    rest_b = list(iter(pipe2))
+    _assert_streams_equal(rest_a, rest_b)
+    for n in trainer.params:
+        np.testing.assert_array_equal(np.asarray(trainer.params[n]),
+                                      np.asarray(trainer2.params[n]))
+    pipe.close()
+    pipe2.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: mxtpu_data_* family
+# ---------------------------------------------------------------------------
+def test_data_telemetry_jsonl_and_report(tmp_path):
+    from incubator_mxnet_tpu import telemetry
+
+    path = str(tmp_path / "run.jsonl")
+    telemetry.set_jsonl(path)
+    try:
+        x, y = _xy(24, 3)
+        feed = data.DevicePrefetcher(data.from_ndarray(x, y).batch(4),
+                                     depth=2, site="t.telemetry")
+        for _ in feed:
+            time.sleep(0.001)
+        feed.close()
+    finally:
+        telemetry.set_jsonl(None)
+    recs = telemetry.read_jsonl(path)
+    drecs = [r for r in recs if r.get("kind") == "data"]
+    assert drecs and drecs[-1]["site"] == "t.telemetry"
+    assert drecs[-1]["epoch_end"] is True
+    assert drecs[-1]["batches"] == 6
+
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.telemetry_report import summarize
+
+    out = summarize(path)
+    assert "input pipeline" in out and "t.telemetry" in out
+
+    reg = telemetry.get_registry()
+    text = telemetry.prometheus_text(reg)
+    assert "mxtpu_data_batches_total" in text
+    assert "mxtpu_data_device_queue_depth" in text
+
+
+# ---------------------------------------------------------------------------
+# io/ satellites
+# ---------------------------------------------------------------------------
+def test_prefetching_iter_worker_death_propagates_no_deadlock():
+    class Bad(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("decode failed")
+            return mio.DataBatch([mx.nd.zeros((2, 2))],
+                                 [mx.nd.zeros((2,))])
+
+    it = mio.PrefetchingIter(Bad())
+    assert it.iter_next() and it.iter_next()
+    with pytest.raises(RuntimeError, match="decode failed"):
+        it.iter_next()                       # surfaces, never hangs
+    it.close()
+    it.close()                               # idempotent
+    assert not it._thread.is_alive()
+
+
+def test_prefetching_iter_close_joins_thread():
+    x, _ = _xy(8, 2)
+    it = mio.PrefetchingIter(mio.NDArrayIter(x, batch_size=4))
+    assert it.iter_next()
+    it.close()
+    assert not it._thread.is_alive()
+
+
+def test_ndarrayiter_seeded_shuffle_reproducible():
+    x, y = _xy(20, 2)
+
+    def labels(seed=None, rng=None):
+        it = mio.NDArrayIter(x, y, batch_size=5, shuffle=True,
+                             seed=seed, rng=rng)
+        out = []
+        for b in it:
+            out.extend(b.label[0].asnumpy().tolist())
+        return out
+
+    assert labels(seed=11) == labels(seed=11)
+    assert labels(seed=11) != labels(seed=12)
+    assert labels(rng=np.random.default_rng(11)) == labels(seed=11)
+    assert sorted(labels(seed=11)) == np.arange(20).tolist()
+
+
+# -- last_batch_handle edge cases (satellite) -------------------------------
+def test_last_batch_pad_wraps_and_getpad():
+    x = np.arange(10).astype(np.float32)
+    it = mio.NDArrayIter(x, batch_size=4, last_batch_handle="pad")
+    batches, pads = [], []
+    while it.iter_next():
+        batches.append(it.getdata()[0].asnumpy().tolist())
+        pads.append(it.getpad())
+    assert pads == [0, 0, 2]
+    assert batches[2] == [8, 9, 0, 1]        # wrap-around padding
+
+
+def test_last_batch_discard_exact_multiple():
+    x = np.arange(8).astype(np.float32)
+    it = mio.NDArrayIter(x, batch_size=4, last_batch_handle="discard")
+    assert sum(1 for _ in it) == 2           # no phantom third batch
+    it.reset()
+    assert sum(1 for _ in it) == 2
+    # non-multiple: partial batch dropped
+    it2 = mio.NDArrayIter(np.arange(10).astype(np.float32), batch_size=4,
+                          last_batch_handle="discard")
+    assert sum(1 for _ in it2) == 2
+
+
+def test_last_batch_roll_over_leftover_leads_next_epoch():
+    x = np.arange(10).astype(np.float32)
+    it = mio.NDArrayIter(x, batch_size=4, last_batch_handle="roll_over")
+    e0 = [b.data[0].asnumpy().tolist() for b in it]
+    assert e0 == [[0, 1, 2, 3], [4, 5, 6, 7]]   # partial deferred
+    it.reset()
+    e1 = [b.data[0].asnumpy().tolist() for b in it]
+    assert e1[0] == [8, 9, 0, 1]             # leftover leads epoch 2
+    assert e1[1] == [2, 3, 4, 5]
+
+
+def test_resize_iter_auto_resets_across_epoch():
+    x = np.arange(8).astype(np.float32)
+    inner = mio.NDArrayIter(x, batch_size=4, last_batch_handle="discard")
+    it = mio.ResizeIter(inner, size=5)
+    got = [b.data[0].asnumpy().tolist() for b in it]
+    assert len(got) == 5                     # 2/epoch + auto-reset
+    assert got[2] == [0.0, 1.0, 2.0, 3.0]    # wrapped to epoch 2
+    it.reset()
+    assert sum(1 for _ in it) == 5
+
+
+# -- ImageRecordIter through the bounded pool (satellite) -------------------
+def test_image_record_iter_bounded_pool(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = (rng.rand(12, 12, 3) * 255).astype(np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, img_fmt=".png"))
+    w.close()
+
+    it = mio.ImageRecordIter(path, (3, 8, 8), batch_size=4,
+                             prefetch_buffer=4)
+    assert it._record_stage is not None      # routed through the pool
+    labels = []
+    n = 0
+    try:
+        while True:
+            b = it.next()
+            labels.extend(b.label[0].asnumpy()[:4 - b.pad].tolist())
+            n += 1
+    except StopIteration:
+        pass
+    assert n == 3 and sorted(labels) == list(range(10))
+    it.reset()                               # epoch 2 through a fresh pool
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 8, 8)
+    it.close()

@@ -136,6 +136,22 @@ def summarize(path: str, merge: bool = False) -> str:
         if live:
             lines.append(f"device memory max live:   "
                          f"{max(live) / 2**20:.1f} MiB")
+    data = {}
+    for r in records:
+        if r.get("kind") == "data":
+            data.setdefault(r.get("site", "?"), []).append(r)
+    if data:
+        lines.append("")
+        lines.append(f"{'input pipeline':24s} {'batches':>8s} "
+                     f"{'input-bound%':>13s} {'epochs':>7s}")
+        for site in sorted(data):
+            recs = data[site]
+            bounds = [r["input_bound_pct"] for r in recs
+                      if "input_bound_pct" in r]
+            lines.append(
+                f"{site:24s} {max(r.get('batches', 0) for r in recs):8d} "
+                f"{(f'{bounds[-1]:.1f}' if bounds else '-'):>13s} "
+                f"{sum(1 for r in recs if r.get('epoch_end')):7d}")
     bench = [r for r in records if r.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -173,6 +189,11 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
             n_rec[site] = n_rec.get(site, 0) + 1
     for site, n in n_rec.items():
         out[f"recompiles/{site}"] = float(n)
+    for r in records:
+        # last data record per site wins: the EMA's final value
+        if r.get("kind") == "data" and "input_bound_pct" in r:
+            out[f"data/{r.get('site', '?')}/input_bound_pct"] = \
+                float(r["input_bound_pct"])
     return out
 
 
