@@ -64,7 +64,7 @@ def __getattr__(name):
                 "operator", "contrib", "np", "npx", "rtc", "callback",
                 "monitor", "visualization", "viz", "name", "attribute",
                 "util", "engine", "registry", "serving", "telemetry",
-                "data"):
+                "data", "resilience"):
         import importlib
 
         mod = importlib.import_module(

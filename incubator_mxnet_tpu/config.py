@@ -248,6 +248,58 @@ config.register(
     "pool shuffle, the reference iterator's shuffle_chunk analog). "
     "Larger = closer to a uniform shuffle, more resident samples.")
 config.register(
+    "MXTPU_RESILIENCE_MAX_RETRIES", 3, int,
+    "Transient-failure retry budget per supervised step (and per batch "
+    "fetch) before the resilience Supervisor escalates to a "
+    "restart-from-checkpoint (docs/RESILIENCE.md retry taxonomy).")
+config.register(
+    "MXTPU_RESILIENCE_BACKOFF_BASE_S", 0.05, float,
+    "First retry delay of the Supervisor's exponential backoff; "
+    "attempt k sleeps base * 2^(k-1) (+ up to 50% deterministic "
+    "jitter), capped by MXTPU_RESILIENCE_BACKOFF_MAX_S.")
+config.register(
+    "MXTPU_RESILIENCE_BACKOFF_MAX_S", 2.0, float,
+    "Upper bound on one Supervisor retry backoff sleep.")
+config.register(
+    "MXTPU_RESILIENCE_WATCHDOG_MULT", 10.0, float,
+    "Hung-step watchdog deadline as a multiple of the step wall-time "
+    "EMA (the PR 4 StepMeter's, compile-dominated steps excluded); "
+    "floored at the Supervisor's min_deadline_s. A step past the "
+    "deadline is counted (mxtpu_resilience_hung_steps_total) and, in "
+    "enforce mode, interrupted and retried as a transient.")
+config.register(
+    "MXTPU_RESILIENCE_MAX_RESTARTS", 2, int,
+    "How many times the Supervisor may restart a run from the newest "
+    "valid checkpoint before re-raising the fatal failure.")
+config.register(
+    "MXTPU_RESILIENCE_KEEP_LAST_K", 3, int,
+    "CheckpointManager retention: always keep the newest K committed "
+    "checkpoints (0 = keep everything).")
+config.register(
+    "MXTPU_RESILIENCE_KEEP_EVERY_N", 0, int,
+    "CheckpointManager retention: additionally pin every checkpoint "
+    "whose step is a multiple of N, beyond keep-last-K (0 = off). The "
+    "keep-hourly-forever pattern for long runs.")
+config.register(
+    "MXTPU_SERVING_DEADLINE_MS", 0.0, float,
+    "Per-request serving deadline: requests that age past this while "
+    "queued are shed with DeadlineExceededError(retry_after) instead "
+    "of served late (graceful degradation under overload; "
+    "mxtpu_serving_deadline_shed_total counts them). 0 disables.")
+config.register(
+    "MXTPU_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
+    "Default ModelServer.drain() timeout: past it a wedged in-flight "
+    "batch is force-closed (warned + counted in "
+    "mxtpu_serving_forced_close_total) so shutdown can never hang.")
+config.register(
+    "MXTPU_CHAOS", "", str,
+    "JSON fault plan for the resilience chaos harness, e.g. "
+    '\'{"seed": 0, "sites": {"step": {"at_calls": [7]}}}\' — applied '
+    "by tools/chaos_soak.py and subprocess chaos tests via "
+    "resilience.chaos.configure_from_env(). Empty (default) disables "
+    "injection; production code paths pay one attribute load per "
+    "registered site.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

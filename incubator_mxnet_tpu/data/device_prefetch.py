@@ -106,11 +106,17 @@ class DevicePrefetcher:
         self._bound_ema: Optional[float] = None
         self._insts = None
         self._closed = False
-        # True only between an epoch's end (or a consumed producer
-        # error) and the next explicit __iter__/load_state_dict — a
-        # fresh prefetcher starts its first epoch from either __iter__
-        # or a bare __next__
+        # True only between an epoch's end and the next explicit
+        # __iter__/load_state_dict — a fresh prefetcher starts its
+        # first epoch from either __iter__ or a bare __next__
         self._epoch_done = False
+        # a producer failure was propagated; the next pull resumes the
+        # epoch from the failure point (resilience retry contract,
+        # docs/RESILIENCE.md — same semantics as the host prefetch
+        # stage). Assumes a resumable source: an mxtpu.data pipeline
+        # continues mid-epoch when re-iterated, which is the supported
+        # checkpointable feed anyway.
+        self._failed = False
 
     # -- telemetry ----------------------------------------------------------
     def _instruments(self):
@@ -157,22 +163,17 @@ class DevicePrefetcher:
     def __iter__(self):
         if self._closed:
             raise RuntimeError("DevicePrefetcher is closed")
-        # mid-epoch (a live producer, or a just-restored state) iteration
-        # CONTINUES the current epoch; a fresh/finished one starts anew
-        if self._producer is None or self._epoch_done:
+        # mid-epoch (a live producer, a just-restored state, or a
+        # propagated failure awaiting its retry) iteration CONTINUES
+        # the current epoch; a fresh/finished one starts anew
+        if not self._failed and (self._producer is None
+                                 or self._epoch_done):
             self._start_epoch()
         return self
 
-    def _start_epoch(self):
+    def _spawn_producer(self):
         from .pipeline import _QueueProducer
 
-        self._join()
-        self._epoch_done = False
-        # after a mid-epoch restore the delivered count continues from
-        # the restored cursor so a later state_dict() stays absolute
-        self._delivered = self._resume_base
-        self._resume_base = 0
-        self._last_return = None
         state = {}
 
         def nxt():
@@ -187,21 +188,42 @@ class DevicePrefetcher:
             nxt, self.depth, self._instruments(),
             name="mxtpu-data-device-prefetch")
 
+    def _start_epoch(self):
+        self._join()
+        self._epoch_done = False
+        self._failed = False
+        # after a mid-epoch restore the delivered count continues from
+        # the restored cursor so a later state_dict() stays absolute
+        self._delivered = self._resume_base
+        self._resume_base = 0
+        self._last_return = None
+        self._spawn_producer()
+
     def __next__(self):
         from .pipeline import _QueueProducer
 
         if self._producer is None:
-            if self._epoch_done:
-                # iterator contract: keep raising after the epoch ends
-                # (and after a consumed producer error) — __iter__ or
-                # load_state_dict starts the next epoch explicitly
+            if self._failed:
+                # retrying a propagated producer failure: the dead
+                # producer delivered everything it produced first, so
+                # the source sits at the failure point — resume the
+                # epoch there, counters intact (NOT _start_epoch, which
+                # would zero the delivered cursor mid-epoch and corrupt
+                # the next checkpoint's input position)
+                self._failed = False
+                self._spawn_producer()
+            elif self._epoch_done:
+                # iterator contract: keep raising after the epoch ends —
+                # __iter__ or load_state_dict starts the next epoch
+                # explicitly
                 raise StopIteration
-            self._start_epoch()
+            else:
+                self._start_epoch()
         insts = self._instruments()
         ok, item, wait = self._producer.get()
         now = time.perf_counter()
         if not ok:
-            self._epoch_done = True
+            self._failed = True
             self._join()
             raise item
         if item is _QueueProducer.DONE:
@@ -255,6 +277,7 @@ class DevicePrefetcher:
         self._source.load_state_dict(inner)
         self._resume_base = int(sd["cursor"])
         self._epoch_done = False     # restored mid-epoch: next use resumes
+        self._failed = False         # a restore supersedes any failure
         self._last_return = None
 
     # -- teardown -----------------------------------------------------------

@@ -105,9 +105,15 @@ class _QueueProducer:
         self._thread.start()
 
     def _produce(self):
+        from ..resilience import chaos   # hoisted: not per-item work
+
         insts = self._insts
         while not self._stop.is_set():
             try:
+                # chaos site BEFORE the pull: an injected worker death
+                # propagates to the consumer without consuming a sample,
+                # so a supervised retry resumes the exact stream
+                chaos.maybe_inject("data.worker", detail=self._thread.name)
                 item = (True, self._next_fn())
             except StopIteration:
                 item = (True, self.DONE)
@@ -688,6 +694,7 @@ class _Prefetch(Stage):
         self.depth = max(1, int(depth))
         self.name = name or "prefetch"
         self._producer: Optional[_QueueProducer] = None
+        self._failed = False        # a worker failure was propagated
         self._insts = None
 
     def _instruments(self):
@@ -697,18 +704,32 @@ class _Prefetch(Stage):
 
     def _start_epoch(self):
         self._join_producer()
+        self._failed = False
         self._producer = _QueueProducer(
             self._source._pull, self.depth, self._instruments(),
             name="mxtpu-data-prefetch")
 
     def _next(self):
         if self._producer is None:
-            # epoch already ended (or error consumed): keep raising,
-            # never block on a dead queue
-            raise StopIteration
+            if self._failed:
+                # a propagated worker failure is RETRYABLE (resilience
+                # contract, docs/RESILIENCE.md): the dead producer
+                # delivered everything it produced before failing, so
+                # the source chain sits exactly at the failure point —
+                # a fresh producer resumes the epoch mid-stream instead
+                # of the old dead-stage behavior (which made the next
+                # pull look like an epoch end and silently skipped the
+                # rest of the epoch). _start_epoch touches no cursors,
+                # it only (re)spawns the producer over the live source.
+                self._start_epoch()
+            else:
+                # epoch already ended: keep raising, never block on a
+                # dead queue
+                raise StopIteration
         ok, item, _ = self._producer.get()
         if not ok:
             self._join_producer()
+            self._failed = True
             raise item
         if item is _QueueProducer.DONE:
             self._join_producer()

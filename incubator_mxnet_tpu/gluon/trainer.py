@@ -413,6 +413,12 @@ class Trainer:
     def step(self, batch_size: int, ignore_stale_grad: bool = False):
         """Rescale by 1/batch_size, allreduce (if distributed), update —
         fused into one executable whenever possible."""
+        # chaos site fires before any optimizer/kvstore mutation so a
+        # supervised retry of this step is clean (docs/RESILIENCE.md)
+        from ..resilience import chaos
+
+        chaos.maybe_inject("step", detail="trainer")
+        chaos.maybe_inject("step.slow", detail="trainer")
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

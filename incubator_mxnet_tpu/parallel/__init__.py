@@ -22,4 +22,5 @@ from .spmd import SPMDTrainer, shard_params
 from .pipeline import (PipelineTrainer, pipeline_apply,
                        pipeline_apply_1f1b, pipeline_apply_interleaved,
                        stack_stage_params)
-from .checkpoint import restore_sharded, save_sharded
+from .checkpoint import (CheckpointError, restore_sharded, save_sharded,
+                         validate_sharded)

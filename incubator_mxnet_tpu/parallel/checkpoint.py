@@ -9,7 +9,8 @@ arrays with their original shardings.
 
 Format (``MXTPU-SHARD-1``):
 - ``{prefix}.manifest.json`` — for every tensor: global shape, dtype,
-  PartitionSpec, and the index ranges of every shard.
+  PartitionSpec, and the index ranges (+ crc32, since PR 6) of every
+  shard.
 - ``{prefix}.shards-{rank}.npz`` — the shards addressable by process
   ``rank`` (replica 0 only, so replicated tensors are written once).
 
@@ -17,13 +18,26 @@ Restore rebuilds each array with ``NamedSharding(mesh, spec)`` on the
 current trainer's mesh. Shard files are expected on a filesystem readable
 by every process needing them (one box in tests; POSIX/NFS or object store
 in a pod).
+
+Integrity contract (docs/RESILIENCE.md): :func:`validate_sharded` proves
+a checkpoint whole — manifest parseable, every shard file present and
+readable, every referenced shard key present with matching shape and
+crc32, every tensor fully covered — and :func:`restore_sharded` runs it
+BEFORE touching any live state, falling back to the newest older valid
+sibling checkpoint (a ``step-N/`` directory laid out by
+``resilience.CheckpointManager``) instead of raising on a torn or
+partial directory. Checkpoints written before PR 6 carry no checksums;
+they validate structurally (shape + coverage) and skip the crc pass.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Dict, List, Tuple
+import re
+import zlib
+from typing import Any, Dict, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +45,22 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 _MAGIC = "MXTPU-SHARD-1"
+
+_log = logging.getLogger("mxtpu.checkpoint")
+
+
+class CheckpointError(ValueError):
+    """A checkpoint failed validation (torn write, missing shard file,
+    checksum mismatch, incomplete coverage). Subclasses ``ValueError``
+    so pre-PR-6 ``except ValueError`` callers keep working."""
+
+
+def _chaos(site: str, detail: str = "") -> None:
+    """Chaos-harness hook (resilience.chaos): a no-op unless a fault
+    plan is active. Lazy import — resilience depends on this module."""
+    from ..resilience import chaos
+
+    chaos.maybe_inject(site, detail)
 
 
 def _spec_to_json(spec: PartitionSpec) -> List:
@@ -104,6 +134,7 @@ def save_sharded(prefix: str, trainer, data_iter=None) -> str:
         from ..data.state import save_iterator_state_file
 
         save_iterator_state_file(f"{prefix}.data-{rank}.json", data_iter)
+    _chaos("checkpoint.write", detail=prefix)
     flat = _flatten_state(trainer.params, trainer.opt_state, trainer.frozen)
 
     manifest = {"magic": _MAGIC, "tensors": {},
@@ -122,16 +153,25 @@ def save_sharded(prefix: str, trainer, data_iter=None) -> str:
             if shard.replica_id != 0:
                 continue
             key = f"{name}::{len(entry['shards'])}@{rank}"
+            data = np.asarray(shard.data)
+            # crc over a contiguous VIEW only — ascontiguousarray
+            # promotes 0-d to (1,), so the stored array must stay `data`
             entry["shards"].append({
                 "rank": rank,
                 "key": key,
                 "index": _index_to_json(shard.index, arr.shape),
+                # integrity: restore proves each shard's bytes before
+                # touching live state (docs/RESILIENCE.md)
+                "crc32": zlib.crc32(np.ascontiguousarray(data).data),
             })
-            local[key] = np.asarray(shard.data)
+            local[key] = data
         manifest["tensors"][name] = entry
 
     np.savez(f"{prefix}.shards-{rank}.npz",
              **{k: v for k, v in local.items()})
+    # the torn-write window: shards are on disk, the manifest is not
+    # yet — a failure here must never be visible as a valid checkpoint
+    _chaos("checkpoint.commit", detail=prefix)
 
     if jax.process_count() > 1:
         # merge shard listings across processes via allgather of manifests
@@ -167,18 +207,158 @@ def save_sharded(prefix: str, trainer, data_iter=None) -> str:
     return f"{prefix}.manifest.json"
 
 
-def restore_sharded(prefix: str, trainer, data_iter=None) -> None:
-    """Restore params/frozen/opt_state in place, preserving shardings on
-    the trainer's current mesh. ``data_iter`` (optional): restore the
-    input pipeline's iteration state from this rank's
-    ``{prefix}.data-{rank}.json`` sidecar (see :func:`save_sharded`) —
-    applied LAST, after the manifest validates and the tensors restore,
-    so a failed/corrupt restore never leaves a live pipeline rewound
-    while the trainer kept its old state."""
-    with open(f"{prefix}.manifest.json") as f:
-        manifest = json.load(f)
+def _load_manifest(prefix: str) -> Dict[str, Any]:
+    mpath = f"{prefix}.manifest.json"
+    if not os.path.exists(mpath):
+        raise CheckpointError(f"no manifest at {mpath}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise CheckpointError(f"unparseable manifest {mpath}: {e}") from e
     if manifest.get("magic") != _MAGIC:
-        raise ValueError(f"not a {_MAGIC} checkpoint: {prefix}")
+        raise CheckpointError(f"not a {_MAGIC} checkpoint: {prefix}")
+    return manifest
+
+
+def validate_sharded(prefix: str) -> Dict[str, Any]:
+    """Prove a sharded checkpoint whole; return its parsed manifest.
+
+    Checks, in order: manifest present/parseable/right magic; every
+    referenced shard file opens as a zip archive; every referenced shard
+    key present with the extents the manifest records; crc32 of the
+    stored bytes matches where the manifest carries one (pre-PR-6
+    checkpoints don't — they get the structural checks only); every
+    tensor's shards cover its full volume (a merge that lost a rank's
+    listing, or a partially-written multi-host save, fails here).
+
+    Raises :class:`CheckpointError`; never touches trainer state, so
+    callers can probe candidates freely (``resilience.CheckpointManager
+    .newest_valid`` walks checkpoints newest-first through this)."""
+    manifest = _load_manifest(prefix)
+    files: Dict[int, Any] = {}
+    ranks = {sh["rank"] for entry in manifest["tensors"].values()
+             for sh in entry["shards"]}
+    for rank in sorted(ranks):
+        path = f"{prefix}.shards-{rank}.npz"
+        if not os.path.exists(path):
+            raise CheckpointError(f"missing shard file {path}")
+        try:
+            files[rank] = np.load(path)
+        except Exception as e:     # zipfile.BadZipFile, OSError, ...
+            raise CheckpointError(
+                f"unreadable shard file {path}: {e}") from e
+    for name, entry in manifest["tensors"].items():
+        shape = tuple(entry["shape"])
+        volume = int(np.prod(shape)) if shape else 1
+        covered = 0
+        if not entry["shards"] and volume:
+            raise CheckpointError(
+                f"tensor {name} has no shards in {prefix}")
+        for sh in entry["shards"]:
+            npz = files[sh["rank"]]
+            if sh["key"] not in getattr(npz, "files", ()):
+                raise CheckpointError(
+                    f"shard {sh['key']} of {name} missing from "
+                    f"{prefix}.shards-{sh['rank']}.npz")
+            try:
+                data = npz[sh["key"]]
+            except Exception as e:  # truncated/corrupt member
+                raise CheckpointError(
+                    f"shard {sh['key']} of {name} unreadable: {e}") from e
+            extents = tuple(b - a for a, b in sh["index"])
+            if tuple(data.shape) != extents:
+                raise CheckpointError(
+                    f"shard {sh['key']} of {name} has shape "
+                    f"{tuple(data.shape)}, manifest says {extents}")
+            if "crc32" in sh:
+                crc = zlib.crc32(np.ascontiguousarray(data).data)
+                if crc != sh["crc32"]:
+                    raise CheckpointError(
+                        f"shard {sh['key']} of {name} fails its "
+                        f"checksum (stored {sh['crc32']}, read {crc})")
+            covered += int(np.prod(extents)) if extents else 1
+        if covered != volume:
+            raise CheckpointError(
+                f"tensor {name} covered {covered} of {volume} elements "
+                f"in {prefix} (incomplete manifest merge or partial "
+                "multi-host save)")
+    return manifest
+
+
+_STEP_DIR_RE = re.compile(r"^step-(\d+)$")
+
+
+def _sibling_fallbacks(prefix: str) -> List[str]:
+    """Older candidate prefixes when ``prefix`` sits in a
+    ``CheckpointManager`` layout (``<root>/step-N/<name>``): the same
+    basename inside every other non-tmp ``step-*`` sibling, newest
+    first. Empty for free-standing prefixes."""
+    step_dir = os.path.dirname(os.path.abspath(prefix))
+    m = _STEP_DIR_RE.match(os.path.basename(step_dir))
+    if not m:
+        return []
+    root, base = os.path.dirname(step_dir), os.path.basename(prefix)
+    me = int(m.group(1))
+    steps = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        sm = _STEP_DIR_RE.match(name)
+        if sm and int(sm.group(1)) != me:
+            # keep the directory name as found — re-formatting the
+            # parsed int would miss differently-padded siblings
+            steps.append((int(sm.group(1)), name))
+    return [os.path.join(root, name, base)
+            for _s, name in sorted(steps, reverse=True)]
+
+
+def restore_sharded(prefix: str, trainer, data_iter=None, *,
+                    validate: bool = True,
+                    fallback: Union[str, Sequence[str], None] = "auto",
+                    ) -> str:
+    """Restore params/frozen/opt_state in place, preserving shardings on
+    the trainer's current mesh; returns the prefix actually restored.
+
+    ``validate=True`` (default) runs :func:`validate_sharded` BEFORE any
+    live state is touched; on failure, ``fallback`` names what to try
+    next: ``"auto"`` (default) probes the newest older valid sibling in
+    a ``step-N/`` checkpoint directory layout, a sequence of prefixes
+    probes those in order, ``None``/``()`` disables fallback. A torn or
+    partial directory therefore restores the last good state (with a
+    warning) instead of raising; only when no candidate validates does
+    :class:`CheckpointError` surface.
+
+    ``data_iter`` (optional): restore the input pipeline's iteration
+    state from this rank's ``{prefix}.data-{rank}.json`` sidecar (see
+    :func:`save_sharded`) — applied LAST, after the manifest validates
+    and the tensors restore, so a failed/corrupt restore never leaves a
+    live pipeline rewound while the trainer kept its old state."""
+    if validate:
+        try:
+            manifest = validate_sharded(prefix)
+        except CheckpointError as first_err:
+            if fallback == "auto":
+                candidates = _sibling_fallbacks(prefix)
+            else:
+                candidates = list(fallback or ())
+            manifest = None
+            for cand in candidates:
+                try:
+                    manifest = validate_sharded(cand)
+                except CheckpointError:
+                    continue
+                _log.warning(
+                    "checkpoint %s failed validation (%s); falling back "
+                    "to %s", prefix, first_err, cand)
+                prefix = cand
+                break
+            if manifest is None:
+                raise first_err
+    else:
+        manifest = _load_manifest(prefix)
 
     shard_files: Dict[int, Any] = {}
 
@@ -237,3 +417,4 @@ def restore_sharded(prefix: str, trainer, data_iter=None) -> None:
 
         load_iterator_state_file(
             f"{prefix}.data-{jax.process_index()}.json", data_iter)
+    return prefix

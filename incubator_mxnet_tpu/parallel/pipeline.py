@@ -670,6 +670,12 @@ class PipelineTrainer:
                                 depth=depth, site="pipeline.data")
 
     def step(self, data, labels) -> float:
+        # chaos sites fire before the rng draw / any state mutation
+        # (resilience contract: a supervised retry is bit-identical)
+        from ..resilience import chaos
+
+        chaos.maybe_inject("step", detail="pipeline")
+        chaos.maybe_inject("step.slow", detail="pipeline")
         x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         y = labels._data if isinstance(labels, NDArray) else \
             jnp.asarray(labels)

@@ -299,6 +299,12 @@ class SPMDTrainer:
         Batches staged by :meth:`device_prefetcher` are already resident
         with the right sharding — the ``device_put`` below is then a
         no-op and the step never blocks on the feed."""
+        # chaos sites fire BEFORE the rng draw / any state mutation, so
+        # a supervised retry of a failed step is bit-identical
+        from ..resilience import chaos
+
+        chaos.maybe_inject("step", detail="spmd")
+        chaos.maybe_inject("step.slow", detail="spmd")
         data = data if isinstance(data, (list, tuple)) else [data]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         data_arrays = [jax.device_put(self._as_jax(d), self._batch_sharding)

@@ -88,5 +88,41 @@ def current_key():
     return _rs.key
 
 
+def get_state():
+    """Snapshot the global generator as plain JSON-able data (root key
+    words + fold-in counter). Captured into checkpoints by
+    ``resilience.CheckpointManager`` so a restored run re-derives the
+    exact per-step key sequence the interrupted run would have drawn —
+    half of the bit-exact-resume contract (docs/RESILIENCE.md); the
+    other half is the data pipeline's ``state_dict``."""
+    import numpy as np
+
+    k = _rs.key
+    try:
+        kd = np.asarray(k)
+        impl = "raw"
+    except TypeError:              # typed PRNG keys (jax_enable_custom_prng)
+        kd = np.asarray(jax.random.key_data(k))
+        impl = str(jax.random.key_impl(k))
+    return {"counter": int(_rs.counter), "impl": impl,
+            "key_data": [int(w) for w in kd.ravel()],
+            "key_shape": list(kd.shape)}
+
+
+def set_state(state) -> None:
+    """Inverse of :func:`get_state` (same thread discipline: the state
+    is thread-local, restore on the thread that steps)."""
+    import numpy as np
+
+    kd = np.asarray(state["key_data"], dtype=np.uint32).reshape(
+        state.get("key_shape", [-1]))
+    if state.get("impl", "raw") == "raw":
+        _rs.key = jnp.asarray(kd)
+    else:
+        _rs.key = jax.random.wrap_key_data(jnp.asarray(kd),
+                                           impl=state["impl"])
+    _rs.counter = int(state["counter"])
+
+
 # Convenience samplers mirroring mx.random.* are installed by the ndarray
 # package (they are ordinary registered ops: uniform, normal, randint, ...).

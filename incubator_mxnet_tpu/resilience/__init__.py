@@ -1,0 +1,42 @@
+"""``mxtpu.resilience`` — fault-tolerant training (docs/RESILIENCE.md).
+
+Production TPU fleets preempt, collectives hang, and writes tear; the
+system-design answer (arXiv:1605.08695 §4.3) is checkpoint-based fault
+tolerance as a first-class subsystem. Three cooperating layers:
+
+* :class:`CheckpointManager` — atomic sharded checkpoints (write to
+  ``step-N.tmp/``, fsync, rename; per-shard checksums in the manifest),
+  async save off the step thread, keep-last-K / keep-every-N retention,
+  restore-newest-valid with fallback.
+* :class:`Supervisor` — wraps a trainer's step loop: transient failures
+  retry with exponential backoff + jitter, a hung-step watchdog arms a
+  deadline from the StepMeter wall-time EMA, fatal failures restart
+  from the newest valid checkpoint (model + optimizer + mid-epoch input
+  position + RNG state rewind together, bit-exactly), SIGTERM triggers
+  a final synchronous checkpoint.
+* :mod:`chaos` — deterministic, seeded fault injection at registered
+  sites, so every recovery path above is exercised by ordinary
+  deterministic tests and ``tools/chaos_soak.py``.
+
+Quick start::
+
+    from incubator_mxnet_tpu import resilience
+
+    mgr = resilience.CheckpointManager("ckpts/", keep_last_k=3)
+    sup = resilience.Supervisor(trainer, mgr, checkpoint_every=50,
+                                enforce_deadline=True)
+    sup.install_preemption_handler()          # SIGTERM -> save + exit
+    losses = sup.run(pipe, steps=10_000)      # resumes automatically
+"""
+
+from . import chaos
+from .chaos import ChaosPlan, InjectedFault
+from .checkpoint_manager import CheckpointManager
+from .supervisor import (FatalError, HungStepError, Preempted, Supervisor,
+                         TransientError, default_classify)
+
+__all__ = [
+    "ChaosPlan", "CheckpointManager", "FatalError", "HungStepError",
+    "InjectedFault", "Preempted", "Supervisor", "TransientError",
+    "chaos", "default_classify",
+]

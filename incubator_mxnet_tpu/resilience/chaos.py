@@ -1,0 +1,273 @@
+"""``resilience.chaos`` — deterministic, seeded fault injection.
+
+Every recovery path in :mod:`incubator_mxnet_tpu.resilience` is only as
+real as the failures that exercise it, so the chaos harness is part of
+the subsystem, not a test-only afterthought (the fault-tolerance design
+point of arXiv:1605.08695 §4.3: recovery code that never runs is
+broken). Production code registers **sites** — named points where a
+fault may be injected — and a seeded :class:`ChaosPlan` decides, purely
+from the per-site call count and the plan's RNG, whether the Nth pass
+through a site raises, sleeps, or hard-exits. Same plan + same seed =
+same fault schedule, every run: chaos tests are ordinary deterministic
+tests.
+
+Site catalog (docs/RESILIENCE.md "Chaos sites"):
+
+=====================  =====================================================
+site                   fires at
+=====================  =====================================================
+``step``               train-step entry (``SPMDTrainer.step``, gluon
+                       ``Trainer.step``, ``PipelineTrainer.step``) —
+                       *before* the step draws RNG keys or mutates any
+                       state, so a retried step is bit-identical
+``step.slow``          train-step entry, for ``sleep`` actions (hung /
+                       straggler step — exercises the supervisor's
+                       hung-step watchdog)
+``checkpoint.write``   inside ``parallel.save_sharded`` after the data
+                       sidecar, before the shard files (a failed write)
+``checkpoint.commit``  after the shard files, before the manifest — the
+                       torn-write window; with ``action='exit'`` this is
+                       the SIGKILL-mid-save scenario
+``data.worker``        inside a data-pipeline producer/worker thread,
+                       before it pulls the next item — the fault
+                       propagates to the consumer's ``next()`` without
+                       consuming a sample, so a retry resumes the exact
+                       stream
+=====================  =====================================================
+
+Usage::
+
+    from incubator_mxnet_tpu.resilience import chaos
+
+    chaos.configure({
+        "step":             {"at_calls": [7], "transient": False},
+        "checkpoint.commit": {"prob": 0.2},
+    }, seed=0)
+    try:
+        ...  # train; every registered site consults the plan
+    finally:
+        chaos.disable()
+
+The module is import-light (stdlib only) and the inactive fast path is
+one module-attribute load per site, so leaving the hooks compiled into
+the hot paths costs nothing when no plan is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ChaosPlan", "InjectedFault", "active", "configure",
+           "configure_from_env", "disable", "events", "fired",
+           "maybe_inject"]
+
+#: site -> one-line description; registration is by convention (the
+#: table above) but anything may be injected at — unknown sites simply
+#: never fire unless a plan names them.
+SITES: Dict[str, str] = {
+    "step": "train-step entry (SPMD / gluon / pipeline trainers)",
+    "step.slow": "train-step entry, sleep actions (hung-step watchdog)",
+    "checkpoint.write": "save_sharded before shard files are written",
+    "checkpoint.commit": "save_sharded torn-write window (shards on "
+                         "disk, manifest not yet)",
+    "data.worker": "data-pipeline producer thread, before the next item",
+}
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the chaos harness. ``transient`` drives the
+    supervisor's retry-vs-restart classification."""
+
+    def __init__(self, site: str, call: int, transient: bool = True):
+        super().__init__(
+            f"chaos: injected fault at site {site!r} (call #{call}, "
+            f"{'transient' if transient else 'fatal'})")
+        self.site = site
+        self.call = call
+        self.transient = transient
+
+
+class ChaosPlan:
+    """A seeded fault schedule over sites.
+
+    ``spec`` maps site name -> a dict with:
+
+    * ``at_calls``: list of 1-based per-site call numbers that fire, or
+    * ``every``: fire every Nth call, or
+    * ``prob``: fire with this probability per call (seeded RNG — still
+      deterministic given the seed and the call order);
+    * ``action``: ``"raise"`` (default) / ``"sleep"`` / ``"exit"``;
+    * ``transient``: bool for raised faults (default True);
+    * ``fatal_calls``: call numbers that fire FATAL regardless of
+      ``transient`` (and fire even without an ``at_calls`` entry) — one
+      site can mix retryable and restart-forcing faults;
+    * ``sleep_s``: seconds for ``sleep`` actions (default 1.0);
+    * ``exit_code``: for ``exit`` actions (default 1 — ``os._exit``, the
+      SIGKILL analog: no cleanup, no atexit, no flushing);
+    * ``max_fires``: cap on how many times the site fires (default
+      unlimited; ``at_calls`` caps itself).
+    """
+
+    def __init__(self, spec: Dict[str, Dict[str, Any]], seed: int = 0):
+        self.seed = int(seed)
+        self.spec = {site: dict(cfg) for site, cfg in spec.items()}
+        for site, cfg in self.spec.items():
+            unknown = set(cfg) - {"at_calls", "every", "prob", "action",
+                                  "transient", "sleep_s", "exit_code",
+                                  "max_fires", "fatal_calls"}
+            if unknown:
+                raise ValueError(
+                    f"chaos spec for {site!r} has unknown keys {unknown}")
+
+    def should_fire(self, cfg: Dict[str, Any], call: int,
+                    rng: "_pyrandom.Random", fires: int) -> bool:
+        limit = cfg.get("max_fires")
+        if limit is not None and fires >= int(limit):
+            return False
+        if call in cfg.get("fatal_calls", ()):
+            return True
+        if "at_calls" in cfg:
+            return call in cfg["at_calls"]
+        if "every" in cfg:
+            n = int(cfg["every"])
+            return n > 0 and call % n == 0
+        if "prob" in cfg:
+            return rng.random() < float(cfg["prob"])
+        return False
+
+
+class _Controller:
+    """The live plan + per-site call/fire ledgers (thread-safe: sites
+    fire from trainer threads, data workers, and checkpoint writers)."""
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
+        self._events: List[Dict[str, Any]] = []
+        # one RNG per site so concurrency on one site cannot perturb
+        # another site's draw sequence; crc32, not hash() — string
+        # hashing is randomized per interpreter (PYTHONHASHSEED), which
+        # would break the same-seed-same-schedule guarantee across runs
+        self._rngs = {
+            site: _pyrandom.Random(plan.seed ^ zlib.crc32(site.encode()))
+            for site in plan.spec}
+
+    def hit(self, site: str, detail: str):
+        cfg = self.plan.spec.get(site)
+        if cfg is None:
+            return None
+        with self._lock:
+            call = self._calls.get(site, 0) + 1
+            self._calls[site] = call
+            fire = self.plan.should_fire(cfg, call, self._rngs[site],
+                                         self._fires.get(site, 0))
+            if not fire:
+                return None
+            self._fires[site] = self._fires.get(site, 0) + 1
+            self._events.append({"site": site, "call": call,
+                                 "action": cfg.get("action", "raise"),
+                                 "detail": detail})
+        return call, cfg
+
+
+_active: Optional[_Controller] = None
+
+
+def configure(spec, seed: int = 0) -> ChaosPlan:
+    """Activate a fault plan (a :class:`ChaosPlan` or its spec dict).
+    Replaces any previous plan; ``disable()`` deactivates."""
+    global _active
+    plan = spec if isinstance(spec, ChaosPlan) else ChaosPlan(spec, seed)
+    _active = _Controller(plan)
+    return plan
+
+
+def configure_from_env() -> Optional[ChaosPlan]:
+    """Activate the plan carried by the ``MXTPU_CHAOS`` knob (a JSON
+    object ``{"seed": int, "sites": {site: cfg, ...}}`` or just the
+    sites mapping). Returns None (and stays inactive) when unset.
+    Used by ``tools/chaos_soak.py`` and subprocess chaos tests."""
+    import json
+
+    from ..config import config
+
+    raw = str(config.get("MXTPU_CHAOS") or "").strip()
+    if not raw:
+        return None
+    data = json.loads(raw)
+    if "sites" in data:
+        return configure(data["sites"], seed=int(data.get("seed", 0)))
+    return configure(data)
+
+
+def disable() -> None:
+    """Deactivate fault injection (hooks return to the no-op fast path)."""
+    global _active
+    _active = None
+
+
+def active() -> bool:
+    return _active is not None
+
+
+def maybe_inject(site: str, detail: str = "") -> None:
+    """The hook production code calls at a registered site. No-op (one
+    attribute load) unless a plan is active and names the site."""
+    ctl = _active
+    if ctl is None:
+        return
+    hit = ctl.hit(site, detail)
+    if hit is None:
+        return
+    call, cfg = hit
+    _count_injection(site)
+    action = cfg.get("action", "raise")
+    if action == "sleep":
+        time.sleep(float(cfg.get("sleep_s", 1.0)))
+        return
+    if action == "exit":
+        # the SIGKILL analog: no cleanup, no atexit, no stream flushing —
+        # whatever is on disk right now is what a restart sees
+        os._exit(int(cfg.get("exit_code", 1)))
+    transient = bool(cfg.get("transient", True)) \
+        and call not in cfg.get("fatal_calls", ())
+    raise InjectedFault(site, call, transient=transient)
+
+
+def _count_injection(site: str) -> None:
+    try:                                   # telemetry optional, lazily
+        from .. import telemetry
+
+        telemetry.counter("mxtpu_chaos_injected_total",
+                          "faults injected by the chaos harness",
+                          site=site).inc()
+    except Exception:
+        pass
+
+
+def fired(site: Optional[str] = None):
+    """Total faults fired (per site, or the whole plan)."""
+    ctl = _active
+    if ctl is None:
+        return 0
+    with ctl._lock:
+        if site is not None:
+            return ctl._fires.get(site, 0)
+        return sum(ctl._fires.values())
+
+
+def events() -> List[Dict[str, Any]]:
+    """The ordered fault log (site, call, action, detail) — for test
+    assertions and the chaos-soak JSONL summary."""
+    ctl = _active
+    if ctl is None:
+        return []
+    with ctl._lock:
+        return list(ctl._events)

@@ -1,0 +1,431 @@
+"""Step supervision: retry, hung-step watchdog, restart-from-checkpoint.
+
+The training loop a preemptible TPU fleet actually needs (docs/
+RESILIENCE.md): the :class:`Supervisor` wraps a trainer's step loop —
+``parallel.SPMDTrainer``, a gluon ``Trainer``/``FusedStep`` closure, or
+``PipelineTrainer`` — and turns the three failure classes into policy:
+
+* **Transient** (tunnel hiccups, injected chaos, a dying data worker):
+  retried in place with exponential backoff + deterministic jitter.
+  Sites inject faults at *step entry*, before the step draws RNG keys
+  or mutates state, so a retried step is bit-identical to one that
+  never failed.
+* **Hung** (a collective waiting on a dead peer, a straggler host): a
+  per-step deadline derived from the PR 4 StepMeter wall-time EMA
+  (``watchdog_multiplier *  EMA``, floored at ``min_deadline_s``).
+  Observational by default; with ``enforce_deadline=True`` (and a Unix
+  main thread) a ``SIGALRM`` timer raises :class:`HungStepError` *into*
+  the step, which is then handled as transient.
+* **Fatal** (everything else, or retries exhausted): restore the newest
+  valid checkpoint — model, optimizer, mid-epoch input position, and
+  global RNG state all rewind together — and resume from the restored
+  step, up to ``max_restarts`` times. Because every rewound ingredient
+  is bit-exact (PR 5 data sidecars + ``random.get_state``), the loss
+  stream after a restart equals the uninterrupted run's
+  (``tests/test_resilience.py`` asserts equality through
+  shuffle+shard+prefetch).
+
+Preemption: ``install_preemption_handler()`` arms SIGTERM (the cloud
+preemption notice); at the next step boundary the supervisor writes a
+final synchronous checkpoint and raises :class:`Preempted` so the
+launcher can exit cleanly and resume elsewhere.
+
+Everything is observable: ``mxtpu_resilience_*`` counters/gauges ride
+the PR 4 registry and exporters, and each retry/restart/preemption
+emits a ``kind: "resilience"`` JSONL record that
+``tools/telemetry_report.py`` summarizes.
+"""
+
+from __future__ import annotations
+
+import logging
+import random as _pyrandom
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .chaos import InjectedFault
+
+_log = logging.getLogger("mxtpu.resilience")
+
+__all__ = ["FatalError", "HungStepError", "Preempted", "Supervisor",
+           "TransientError", "default_classify"]
+
+
+class TransientError(RuntimeError):
+    """Raise (or classify into) this to request a retry."""
+
+
+class FatalError(RuntimeError):
+    """Raise (or classify into) this to force restart-from-checkpoint."""
+
+
+class HungStepError(TransientError):
+    """A step exceeded its watchdog deadline (enforce mode)."""
+
+
+class Preempted(SystemExit):
+    """The run was preempted (SIGTERM / ``request_preemption``); a final
+    checkpoint was committed at ``step``. SystemExit subclass so an
+    unhandled preemption exits cleanly, not with a traceback."""
+
+    def __init__(self, step: int):
+        super().__init__(0)
+        self.step = step
+
+
+#: substrings of exception text that mark infrastructure transients
+#: (PJRT tunnel resets, collective timeouts, preemption notices)
+_TRANSIENT_PATTERNS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                       "remote_compile", "preempt", "socket",
+                       "connection reset", "Connection reset",
+                       "INTERNAL")
+
+
+def default_classify(exc: BaseException) -> bool:
+    """True = transient (retry), False = fatal (restart). The retry
+    taxonomy (docs/RESILIENCE.md): explicit marker classes first, then
+    chaos faults by their ``transient`` flag, then OS/IO errors and the
+    known infrastructure patterns; everything else — shape errors,
+    NaN checks, assertion failures — is a program bug and retrying it
+    would just re-raise it ``max_retries`` times."""
+    if isinstance(exc, TransientError):
+        return True
+    if isinstance(exc, FatalError):
+        return False
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    if isinstance(exc, (OSError, ConnectionError, TimeoutError)):
+        return True
+    text = str(exc)
+    return any(pat in text for pat in _TRANSIENT_PATTERNS)
+
+
+def _cfg(name: str):
+    from ..config import config
+
+    return config.get(name)
+
+
+class Supervisor:
+    """Run a trainer's step loop to completion through failures.
+
+    ``trainer`` needs a ``step(*batch) -> loss`` method (SPMDTrainer,
+    PipelineTrainer) or pass ``step_fn`` for anything else (a gluon
+    ``Trainer`` loop body, a ``FusedStep`` closure). ``manager`` (a
+    :class:`CheckpointManager`) enables checkpointing and restarts;
+    without one, fatal failures re-raise immediately.
+    """
+
+    def __init__(self, trainer, manager=None, *,
+                 step_fn: Optional[Callable] = None,
+                 checkpoint_every: int = 0,
+                 final_checkpoint: bool = True,
+                 max_retries: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 watchdog_multiplier: Optional[float] = None,
+                 min_deadline_s: float = 1.0,
+                 enforce_deadline: bool = False,
+                 classify: Callable[[BaseException], bool] = None,
+                 seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 site: str = "supervisor"):
+        self.trainer = trainer
+        self.manager = manager
+        self._step_fn = step_fn if step_fn is not None else trainer.step
+        self.checkpoint_every = int(checkpoint_every)
+        self.final_checkpoint = bool(final_checkpoint)
+        self.max_retries = int(_cfg("MXTPU_RESILIENCE_MAX_RETRIES")
+                               if max_retries is None else max_retries)
+        self.backoff_base_s = float(_cfg("MXTPU_RESILIENCE_BACKOFF_BASE_S")
+                                    if backoff_base_s is None
+                                    else backoff_base_s)
+        self.backoff_max_s = float(_cfg("MXTPU_RESILIENCE_BACKOFF_MAX_S")
+                                   if backoff_max_s is None
+                                   else backoff_max_s)
+        self.max_restarts = int(_cfg("MXTPU_RESILIENCE_MAX_RESTARTS")
+                                if max_restarts is None else max_restarts)
+        self.watchdog_multiplier = float(
+            _cfg("MXTPU_RESILIENCE_WATCHDOG_MULT")
+            if watchdog_multiplier is None else watchdog_multiplier)
+        self.min_deadline_s = float(min_deadline_s)
+        self.enforce_deadline = bool(enforce_deadline)
+        self.classify = classify if classify is not None \
+            else default_classify
+        self.site = site
+        self._sleep = sleep
+        self._rng = _pyrandom.Random(seed)   # backoff jitter only
+        self.step_num = 0
+        self.retries = 0
+        self.restarts = 0
+        self.hung_steps = 0
+        self._ema_s: Optional[float] = None  # fallback when no StepMeter
+        self._preempt = threading.Event()
+        self._prev_handlers: Dict[int, Any] = {}
+        from .. import telemetry
+
+        self._t_retries = telemetry.counter(
+            "mxtpu_resilience_retries_total",
+            "transient step failures retried", site=site)
+        self._t_restarts = telemetry.counter(
+            "mxtpu_resilience_restarts_total",
+            "restarts from the newest valid checkpoint", site=site)
+        self._t_hung = telemetry.counter(
+            "mxtpu_resilience_hung_steps_total",
+            "steps that exceeded the watchdog deadline", site=site)
+        self._t_age = telemetry.gauge(
+            "mxtpu_resilience_last_good_age_seconds",
+            "seconds since the newest committed checkpoint", site=site)
+
+    # -- preemption ----------------------------------------------------------
+    def install_preemption_handler(self, signals=(signal.SIGTERM,)) -> None:
+        """Arm OS signals as preemption notices: the handler only sets a
+        flag; the loop checkpoints synchronously at the next step
+        boundary and raises :class:`Preempted`. Main-thread only (a
+        Python signal constraint)."""
+        for sig in signals:
+            self._prev_handlers[sig] = signal.signal(
+                sig, lambda _s, _f: self._preempt.set())
+
+    def uninstall_preemption_handler(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+
+    def request_preemption(self) -> None:
+        """Programmatic preemption notice (what the SIGTERM handler
+        does): finish the in-flight step, checkpoint, exit."""
+        self._preempt.set()
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt.is_set()
+
+    # -- the supervised loop --------------------------------------------------
+    def run(self, feed, steps: int, start_step: Optional[int] = None
+            ) -> List[float]:
+        """Run ``steps`` supervised steps pulling batches from ``feed``
+        (an ``mxtpu.data`` pipeline or any re-iterable of batches;
+        exhausting it starts the next epoch). Returns the loss per step,
+        indexed by global step — after a restart, re-run steps overwrite
+        their slot, so the returned stream is the one an uninterrupted
+        run produces.
+
+        ``start_step=None`` resumes from the newest valid checkpoint
+        when a manager is attached (fresh start when none exists);
+        pass ``0`` to force a fresh start. A run resumed mid-stream
+        reports NaN for the steps the previous incarnation executed —
+        those losses died with that process; everything from the
+        restored step on is the bit-exact continuation."""
+        if start_step is None:
+            start_step = 0
+            if self.manager is not None:
+                restored = self.manager.restore_latest(
+                    self.trainer, data_iter=self._resumable(feed))
+                if restored is not None:
+                    start_step = restored
+        self.step_num = int(start_step)
+        losses: Dict[int, float] = {}
+        feed_iter = iter(feed)
+        while self.step_num < steps:
+            if self._preempt.is_set():
+                self._checkpoint(feed, sync=True)
+                self._emit({"event": "preempted", "step": self.step_num})
+                raise Preempted(self.step_num)
+            try:
+                batch, feed_iter = self._next_batch(feed, feed_iter)
+                loss = self._attempt(batch)
+            except BaseException as exc:
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                feed_iter = self._restart(feed, exc)
+                continue
+            losses[self.step_num] = loss
+            self.step_num += 1
+            if self.manager is not None:
+                if self.checkpoint_every \
+                        and self.step_num % self.checkpoint_every == 0:
+                    self._checkpoint(feed)
+                age = self.manager.age_seconds()
+                if age is not None:
+                    self._t_age.set(age)
+        if self.manager is not None and self.final_checkpoint \
+                and self.manager.last_good_step != self.step_num:
+            self._checkpoint(feed, sync=True)
+        return [float(losses.get(i, float("nan")))
+                for i in range(int(steps))]
+
+    # -- pieces ---------------------------------------------------------------
+    @staticmethod
+    def _resumable(feed):
+        """The feed rides the checkpoint only when it speaks the resume
+        protocol; a plain re-iterable (supported by run()) trains fine,
+        it just restarts its stream from the top after a restore."""
+        return feed if hasattr(feed, "state_dict") else None
+
+    def _checkpoint(self, feed, sync: bool = False) -> None:
+        if self.manager is None:
+            return
+        if sync:
+            try:
+                self.manager.wait()
+            except Exception as e:
+                # an EARLIER async save failed — already counted
+                # (mxtpu_resilience_checkpoint_failures_total) and its
+                # torn tmp dir is invisible; the sync save below
+                # supersedes it. Only that save's own failure raises.
+                _log.warning("async save had failed (%s); superseding "
+                             "with a fresh synchronous save", e)
+        self.manager.save(self.step_num, self.trainer,
+                          data_iter=self._resumable(feed), sync=sync)
+
+    def _next_batch(self, feed, feed_iter):
+        """Pull one batch, retrying transient feed failures (a data
+        worker dying surfaces at ``next()`` — docs/DATA.md exception
+        propagation) and wrapping epochs."""
+        attempt = 0
+        empty_epochs = 0
+        while True:
+            try:
+                return next(feed_iter), feed_iter
+            except StopIteration:
+                # two consecutive StopIterations without an item mean
+                # the feed yields nothing (a shard with no samples,
+                # drop_last over a short epoch) — error out instead of
+                # busy-looping on iter(feed) forever
+                empty_epochs += 1
+                if empty_epochs > 1:
+                    raise FatalError(
+                        "feed produced no batches for a whole epoch — "
+                        "nothing to train on") from None
+                feed_iter = iter(feed)     # next epoch
+            except BaseException as exc:
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                attempt += 1
+                if not self.classify(exc) or attempt > self.max_retries:
+                    raise
+                self._note_retry("feed", exc, attempt)
+                self._backoff(attempt)
+
+    def _attempt(self, batch) -> float:
+        """One step with transient retries. A transient fault fires at
+        step entry (chaos contract) or from infrastructure below the
+        step; either way the trainer state is the pre-step state, so the
+        retry recomputes the identical step."""
+        args = batch if isinstance(batch, tuple) else (batch,)
+        attempt = 0
+        while True:
+            try:
+                return self._with_deadline(args)
+            except BaseException as exc:
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                attempt += 1
+                if not self.classify(exc) or attempt > self.max_retries:
+                    raise
+                self._note_retry("step", exc, attempt)
+                self._backoff(attempt)
+
+    def _deadline_s(self) -> Optional[float]:
+        meter = getattr(self.trainer, "_telemetry", None)
+        ema = getattr(meter, "ema_seconds", None)
+        if ema is None:
+            ema = self._ema_s
+        if ema is None:
+            return None                    # no evidence yet: disarmed
+        return max(self.min_deadline_s, self.watchdog_multiplier * ema)
+
+    def _with_deadline(self, args) -> float:
+        deadline = self._deadline_s()
+        use_alarm = (self.enforce_deadline and deadline is not None
+                     and hasattr(signal, "SIGALRM")
+                     and threading.current_thread()
+                     is threading.main_thread())
+
+        def on_alarm(_sig, _frm):
+            raise HungStepError(
+                f"step {self.step_num} exceeded its "
+                f"{deadline:.2f}s watchdog deadline")
+
+        prev = None
+        if use_alarm:
+            prev = signal.signal(signal.SIGALRM, on_alarm)
+            signal.setitimer(signal.ITIMER_REAL, deadline)
+        t0 = time.perf_counter()
+        try:
+            loss = self._step_fn(*args)
+        except HungStepError:
+            self.hung_steps += 1
+            self._t_hung.inc()
+            self._emit({"event": "hung_step", "step": self.step_num,
+                        "deadline_s": round(deadline, 3)})
+            raise
+        finally:
+            if use_alarm:
+                signal.setitimer(signal.ITIMER_REAL, 0.0)
+                signal.signal(signal.SIGALRM, prev)
+        dt = time.perf_counter() - t0
+        if deadline is not None and not use_alarm and dt > deadline:
+            # observational watchdog: too late to interrupt, still count
+            self.hung_steps += 1
+            self._t_hung.inc()
+            self._emit({"event": "hung_step", "step": self.step_num,
+                        "deadline_s": round(deadline, 3),
+                        "wall_s": round(dt, 3)})
+        self._ema_s = dt if self._ema_s is None \
+            else 0.7 * self._ema_s + 0.3 * dt
+        return loss
+
+    def _backoff(self, attempt: int) -> None:
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s * (2.0 ** (attempt - 1)))
+        delay *= 1.0 + 0.5 * self._rng.random()   # jitter: no thundering herd
+        self._sleep(delay)
+
+    def _note_retry(self, what: str, exc: BaseException,
+                    attempt: int) -> None:
+        self.retries += 1
+        self._t_retries.inc()
+        self._emit({"event": "retry", "step": self.step_num,
+                    "where": what, "attempt": attempt,
+                    "error": str(exc)[:200]})
+        _log.warning("transient %s failure at step %d (attempt %d/%d): "
+                     "%s", what, self.step_num, attempt,
+                     self.max_retries, exc)
+
+    def _restart(self, feed, exc: BaseException):
+        """Fatal path: restore the newest valid checkpoint and resume
+        from its step; re-raise when restarts are exhausted or there is
+        nothing to restore from."""
+        if self.manager is None:
+            raise exc
+        if self.restarts >= self.max_restarts:
+            _log.error("restart budget exhausted (%d); giving up",
+                       self.max_restarts)
+            raise exc
+        try:
+            self.manager.wait()            # settle in-flight saves first
+        except Exception as save_err:
+            _log.warning("async save failed before restart: %s", save_err)
+        restored = self.manager.restore_latest(
+            self.trainer, data_iter=self._resumable(feed))
+        if restored is None:
+            raise exc
+        self.restarts += 1
+        self._t_restarts.inc()
+        self._emit({"event": "restart", "from_step": self.step_num,
+                    "to_step": restored, "error": str(exc)[:200]})
+        _log.warning("restarting from checkpoint step %d after: %s",
+                     restored, exc)
+        self.step_num = restored
+        return iter(feed)                  # pipeline state was rewound
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        from .. import telemetry
+
+        telemetry.jsonl_emit({"kind": "resilience", "site": self.site,
+                              **record})

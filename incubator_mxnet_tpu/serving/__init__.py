@@ -10,19 +10,24 @@ the C predict API, and MXNet Model Server, rebuilt TPU-native:
   under a ``max_batch_size`` / ``max_wait_ms`` flush policy, with
   bounded-queue backpressure (``QueueFullError.retry_after``).
 * ``ModelServer`` — load (gluon Block, native checkpoint, or
-  ``export_for_serving`` artifacts), warm up, serve, drain, shut down.
+  ``export_for_serving`` artifacts), warm up, serve, drain (with a
+  forced-close timeout), shut down; per-request deadlines shed
+  requests that can no longer meet their SLO
+  (``DeadlineExceededError.retry_after``) and ``healthz()`` reports
+  readiness for a routing front door.
 * ``ServingMetrics`` — latency percentiles, queue depth, batch
   occupancy, cache hit/miss — also published into profiler traces.
 """
 
-from .batcher import DynamicBatcher, QueueFullError, ServerClosedError
+from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
+                      ServerClosedError)
 from .executor_cache import (DEFAULT_BUCKETS, BucketedExecutorCache,
                              block_apply_fn)
 from .metrics import ServingMetrics
 from .server import ModelServer
 
 __all__ = [
-    "BucketedExecutorCache", "DEFAULT_BUCKETS", "DynamicBatcher",
-    "ModelServer", "QueueFullError", "ServerClosedError", "ServingMetrics",
-    "block_apply_fn",
+    "BucketedExecutorCache", "DEFAULT_BUCKETS", "DeadlineExceededError",
+    "DynamicBatcher", "ModelServer", "QueueFullError", "ServerClosedError",
+    "ServingMetrics", "block_apply_fn",
 ]

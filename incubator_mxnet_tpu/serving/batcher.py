@@ -36,6 +36,18 @@ class QueueFullError(RuntimeError):
         self.retry_after = retry_after
 
 
+class DeadlineExceededError(RuntimeError):
+    """The request aged past the server's per-request deadline while
+    queued and was shed (graceful degradation under overload: answering
+    it late would still miss the client's SLO, so free the batch slot
+    for requests that can still make theirs). Retry after
+    ``retry_after`` seconds."""
+
+    def __init__(self, message: str, retry_after: float):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 class ServerClosedError(RuntimeError):
     """Submitted to a draining or shut-down server."""
 
@@ -51,13 +63,17 @@ class DynamicBatcher:
     def __init__(self, runner: Callable, max_batch_size: int = 8,
                  max_wait_ms: float = 5.0, max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 name: str = "model"):
+                 name: str = "model",
+                 deadline_ms: Optional[float] = None):
         if max_batch_size < 1 or max_queue < 1:
             raise ValueError("max_batch_size and max_queue must be >= 1")
+        if deadline_ms is not None and deadline_ms <= 0:
+            deadline_ms = None
         self._runner = runner
         self.max_batch_size = int(max_batch_size)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self.metrics = metrics if metrics is not None else ServingMetrics(name)
         self._cv = threading.Condition()
         self._queue: deque = deque()   # (example, t_submit, future)
@@ -133,10 +149,27 @@ class DynamicBatcher:
                 self._cv.wait(timeout=remaining)
             if self._state == "closed":
                 return None            # close() already failed the queue
+            shed: List[Tuple] = []
+            if self.deadline_ms is not None:
+                # deadline shedding (graceful degradation): requests that
+                # aged past the per-request deadline while queued are
+                # failed with retry_after instead of occupying batch
+                # slots — the queue is FIFO over monotonic submit times,
+                # so only the front can be expired
+                cutoff = time.monotonic() - self.deadline_ms / 1e3
+                while self._queue and self._queue[0][1] < cutoff:
+                    shed.append(self._queue.popleft())
             k = min(len(self._queue), self.max_batch_size)
             items = [self._queue.popleft() for _ in range(k)]
             self.metrics.observe_queue_depth(len(self._queue))
-            return items
+            retry_after = self._retry_after_locked() if shed else 0.0
+        for _, _, f in shed:           # futures resolve outside the lock
+            self.metrics.observe_shed()
+            if not f.done():
+                f.set_exception(DeadlineExceededError(
+                    f"request exceeded its {self.deadline_ms:.1f} ms "
+                    "deadline while queued", retry_after=retry_after))
+        return items
 
     def _run_batch(self, items: List[Tuple]) -> None:
         futures = [f for _, _, f in items]
@@ -172,6 +205,8 @@ class DynamicBatcher:
             items = self._next_batch()
             if items is None:
                 return
+            if not items:              # every queued request was shed
+                continue
             try:
                 self._run_batch(items)
             except Exception as exc:   # noqa: BLE001 — worker must survive
@@ -189,8 +224,13 @@ class DynamicBatcher:
         self._worker.join(timeout)
         return not self._worker.is_alive()
 
-    def close(self) -> None:
-        """Stop now: fail queued requests (in-flight batch still lands)."""
+    def close(self, join_timeout: float = 5.0) -> None:
+        """Stop now: fail queued requests (in-flight batch still lands).
+        ``join_timeout`` bounds the wait for the worker — a force-close
+        after a timed-out drain passes a short one, because the worker
+        is already known to be wedged and waiting on it is pointless
+        (it is a daemon thread; a stuck in-flight future stays
+        unresolved)."""
         with self._cv:
             self._state = "closed"
             pending = list(self._queue)
@@ -199,4 +239,4 @@ class DynamicBatcher:
         for _, _, f in pending:
             if not f.done():
                 f.set_exception(ServerClosedError("server closed"))
-        self._worker.join(timeout=5.0)
+        self._worker.join(timeout=join_timeout)

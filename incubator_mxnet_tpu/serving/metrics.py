@@ -54,6 +54,8 @@ class ServingMetrics:
         self._batch_sizes = deque(maxlen=window)   # requests per batch
         self.requests = 0
         self.rejected = 0
+        self.shed = 0
+        self.forced_closes = 0
         self.batches = 0
         self.cache_hits = 0
         self.cache_misses = 0
@@ -70,6 +72,12 @@ class ServingMetrics:
         self._t_rejected = telemetry.counter(
             "mxtpu_serving_rejected_total",
             "requests rejected by backpressure", **lbl)
+        self._t_shed = telemetry.counter(
+            "mxtpu_serving_deadline_shed_total",
+            "queued requests shed past their per-request deadline", **lbl)
+        self._t_forced = telemetry.counter(
+            "mxtpu_serving_forced_close_total",
+            "drains force-closed after their timeout expired", **lbl)
         self._t_batches = telemetry.counter(
             "mxtpu_serving_batches_total", "batches executed", **lbl)
         self._t_queue = telemetry.gauge(
@@ -105,6 +113,20 @@ class ServingMetrics:
         with self._lock:
             self.rejected += 1
         self._t_rejected.inc()
+
+    def observe_shed(self) -> None:
+        """A queued request aged past the per-request deadline and was
+        failed with ``DeadlineExceededError`` instead of served late."""
+        with self._lock:
+            self.shed += 1
+        self._t_shed.inc()
+
+    def observe_forced_close(self) -> None:
+        """A graceful drain hit its timeout and was force-closed with
+        requests still in flight (docs/SERVING.md shutdown contract)."""
+        with self._lock:
+            self.forced_closes += 1
+        self._t_forced.inc()
 
     def observe_batch(self, batch_size: int) -> None:
         with self._lock:
@@ -160,6 +182,8 @@ class ServingMetrics:
             "model": self.model,
             "requests": self.requests,
             "rejected": self.rejected,
+            "shed": self.shed,
+            "forced_closes": self.forced_closes,
             "batches": self.batches,
             "queue_depth": self.queue_depth,
             "batch_occupancy": occ,

@@ -24,7 +24,9 @@ Usage::
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 from concurrent.futures import Future
 from typing import Any, Optional, Sequence, Tuple
 
@@ -32,11 +34,13 @@ import numpy as np
 
 from .. import profiler
 from .. import telemetry
-from .batcher import DynamicBatcher, QueueFullError, ServerClosedError
+from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
+                      ServerClosedError)
 from .executor_cache import DEFAULT_BUCKETS, BucketedExecutorCache
 from .metrics import ServingMetrics
 
-__all__ = ["ModelServer", "QueueFullError", "ServerClosedError"]
+__all__ = ["DeadlineExceededError", "ModelServer", "QueueFullError",
+           "ServerClosedError"]
 
 
 class ModelServer:
@@ -52,7 +56,8 @@ class ModelServer:
                  max_batch_size: Optional[int] = None,
                  max_wait_ms: float = 5.0, max_queue: int = 64,
                  name: Optional[str] = None,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 deadline_ms: Optional[float] = None):
         if isinstance(model, BucketedExecutorCache):
             if buckets is not None or donate is not None:
                 raise ValueError(
@@ -74,11 +79,17 @@ class ModelServer:
             raise ValueError(
                 f"max_batch_size={max_batch_size} exceeds the largest "
                 f"bucket {self._cache.max_batch_size}")
+        if deadline_ms is None:
+            from ..config import config
+
+            deadline_ms = float(config.get("MXTPU_SERVING_DEADLINE_MS"))
         self._batcher = DynamicBatcher(
             self._run_batch, max_batch_size=max_batch_size,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
-            metrics=self.metrics, name=name)
+            metrics=self.metrics, name=name, deadline_ms=deadline_ms)
         self._meter = telemetry.StepMeter(f"serving.{name}")
+        self._maintenance = 0          # healthz unready while > 0
+        self._maintenance_lock = threading.Lock()
         telemetry.maybe_start_http()
 
     # -- construction from artifacts -----------------------------------------
@@ -164,12 +175,67 @@ class ModelServer:
         self._batcher.expect_features(tuple(feature_shape), dtype)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Graceful: refuse new requests, answer everything queued."""
-        return self._batcher.drain(timeout)
+        """Graceful: refuse new requests, answer everything queued —
+        but never hang shutdown forever: after ``timeout`` seconds
+        (default ``MXTPU_SERVING_DRAIN_TIMEOUT_S``) a wedged in-flight
+        batch is force-closed with a warning, queued requests fail with
+        ``ServerClosedError``, and the event is counted in
+        ``mxtpu_serving_forced_close_total``. Returns True on a clean
+        drain, False when it had to force-close."""
+        if timeout is None:
+            from ..config import config
+
+            timeout = float(config.get("MXTPU_SERVING_DRAIN_TIMEOUT_S"))
+        if self._batcher.drain(timeout):
+            return True
+        logging.getLogger("mxtpu.serving").warning(
+            "drain of %s did not finish within %.1fs (queue_depth=%d); "
+            "force-closing", self.name, timeout, self.queue_depth)
+        self.metrics.observe_forced_close()
+        self._batcher.close(join_timeout=0.5)
+        return False
 
     def close(self) -> None:
         """Immediate: fail queued requests, stop the worker."""
         self._batcher.close()
+
+    def maintenance(self):
+        """Context manager flipping :meth:`healthz` unready for the
+        duration (hot-restore / weight-swap window: the load balancer
+        stops routing new traffic here while in-flight requests keep
+        being served)."""
+        server = self
+
+        class _Maintenance:
+            def __enter__(self):
+                with server._maintenance_lock:
+                    server._maintenance += 1
+                return server
+
+            def __exit__(self, *exc):
+                with server._maintenance_lock:
+                    server._maintenance -= 1
+                return False
+
+        return _Maintenance()
+
+    def healthz(self) -> dict:
+        """Readiness probe (the k8s-style health endpoint contract):
+        ``ready`` is True only while the server is accepting and
+        serving traffic — it flips False during drain/close and inside
+        a :meth:`maintenance` window (hot-restore), so a front door can
+        stop routing before requests start failing."""
+        state = self._batcher._state
+        with self._maintenance_lock:
+            in_maintenance = self._maintenance > 0
+        return {
+            "ready": state == "running" and not in_maintenance,
+            "state": state,
+            "maintenance": in_maintenance,
+            "model": self.name,
+            "queue_depth": self.queue_depth,
+            "compiled_buckets": len(self.compiled_signatures()),
+        }
 
     def __enter__(self) -> "ModelServer":
         return self

@@ -1,0 +1,72 @@
+"""Subprocess payload for the kill-during-save chaos test
+(tests/test_resilience.py::test_kill_during_save_leaves_restorable_state).
+
+Run as ``python chaos_kill_payload.py <checkpoint_root>``:
+
+1. builds the deterministic trainer, runs one step, commits checkpoint
+   step 1 synchronously, and records the post-step-1 parameter values
+   next to the root for the parent to compare against;
+2. runs a second step, then saves step 2 with a chaos ``exit`` fault
+   armed in the torn-write window (shards on disk, manifest not yet) —
+   ``os._exit(7)``, the SIGKILL analog: no cleanup, no atexit, nothing
+   flushed.
+
+The parent asserts the process died with code 7, that step 2 never
+became visible, and that the newest valid checkpoint (step 1) restores
+bit-exactly.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+EXIT_CODE = 7
+
+
+def build_trainer():
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(3)
+    np.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}), donate=False)
+    rs = np.random.RandomState(4)
+    batch = (rs.rand(16, 8).astype(np.float32),
+             rs.randint(0, 4, (16,)).astype(np.float32))
+    return tr, batch
+
+
+def main():
+    import numpy as np
+
+    from incubator_mxnet_tpu import resilience
+
+    root = sys.argv[1]
+    tr, batch = build_trainer()
+    mgr = resilience.CheckpointManager(root, keep_last_k=5)
+    tr.step(*batch)
+    mgr.save(1, tr, sync=True)
+    np.savez(os.path.join(root, "params_at_1.npz"),
+             **{n: np.asarray(v) for n, v in tr.params.items()})
+    tr.step(*batch)
+    resilience.chaos.configure({"checkpoint.commit": {
+        "at_calls": [1], "action": "exit", "exit_code": EXIT_CODE}})
+    mgr.save(2, tr, sync=True)            # never returns: os._exit(7)
+    print("UNREACHABLE: chaos exit did not fire")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -267,7 +267,9 @@ def test_restore_sharded_validates_before_touching_data_iter(tmp_path):
     pipe = data.from_ndarray(x, y).batch(4)
     it = iter(pipe)
     next(it)
-    with pytest.raises(OSError):
+    # missing manifest is now a typed validation failure (PR 6:
+    # CheckpointError, raised BEFORE any state is touched)
+    with pytest.raises(parallel.CheckpointError):
         parallel.restore_sharded(str(tmp_path / "nope"), object(),
                                  data_iter=pipe)
     # pipeline untouched: continues from batch 1

@@ -1,0 +1,682 @@
+"""mxtpu.resilience — fault-tolerant training (docs/RESILIENCE.md).
+
+Chaos-driven proofs of the ISSUE 6 acceptance criteria: a SIGKILL mid
+checkpoint-write never corrupts restorable state; supervised resume is
+bit-exact through shuffle+shard+prefetch; data-worker death recovers by
+retry; torn/corrupt checkpoints validate as invalid and restore falls
+back to the newest older valid one.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import data as mxdata
+from incubator_mxnet_tpu import gluon, parallel, resilience
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    yield
+    chaos.disable()
+
+
+def _trainer(seed=0, donate=False):
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    return parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}), donate=donate)
+
+
+def _pipe(n=64, batch=8, seed=5):
+    x = np.random.RandomState(1).rand(n, 8).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 4, (n,)).astype(np.float32)
+    return (mxdata.from_ndarray(x, y).shuffle(16, seed=seed)
+            .shard(0, 1).batch(batch).prefetch(2))
+
+
+def _batch(seed=7):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(16, 8).astype(np.float32),
+            rs.randint(0, 4, (16,)).astype(np.float32))
+
+
+_REF_CACHE = {}
+
+
+def _plain_run(steps, trainer_seed=0, pipe_seed=5, rng_seed=42):
+    """The uninterrupted deterministic reference loss stream. Cached as
+    a 12-step prefix per seed triple: the trajectory of step i does not
+    depend on later steps, so every shorter reference is a slice —
+    saves a trainer build + jit compile + step loop per test."""
+    key = (trainer_seed, pipe_seed, rng_seed)
+    n = max(12, steps)
+    cached = _REF_CACHE.get(key)
+    if cached is None or len(cached) < n:
+        mx.random.seed(rng_seed)
+        tr = _trainer(trainer_seed)
+        pipe = _pipe(seed=pipe_seed)
+        losses, it = [], iter(pipe)
+        for _ in range(n):
+            try:
+                b = next(it)
+            except StopIteration:
+                it = iter(pipe)
+                b = next(it)
+            losses.append(float(tr.step(*b)))
+        pipe.close()
+        _REF_CACHE[key] = cached = losses
+    return cached[:steps]
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: atomicity, retention, discovery
+# ---------------------------------------------------------------------------
+def test_manager_save_restore_roundtrip_with_rng_and_data(tmp_path):
+    mx.random.seed(11)
+    tr = _trainer()
+    pipe = _pipe()
+    it = iter(pipe)
+    tr.step(*next(it))
+    tr.step(*next(it))
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    mgr.save(2, tr, data_iter=pipe, sync=True)
+    assert mgr.checkpoints() == [2]
+    assert mgr.newest_valid() == 2
+    rng_before = mx.random.get_state()
+    next_batches = [next(it) for _ in range(2)]
+
+    # scribble over everything, then restore
+    mx.random.seed(999)
+    tr2 = _trainer(seed=123)
+    pipe2 = _pipe()
+    mgr2 = resilience.CheckpointManager(str(tmp_path))
+    assert mgr2.restore_latest(tr2, data_iter=pipe2) == 2
+    for n in tr.params:
+        np.testing.assert_array_equal(np.asarray(tr.params[n]),
+                                      np.asarray(tr2.params[n]))
+    assert mx.random.get_state() == rng_before
+    it2 = iter(pipe2)
+    for want in next_batches:          # input position restored mid-epoch
+        got = next(it2)
+        np.testing.assert_array_equal(want[0], got[0])
+        np.testing.assert_array_equal(want[1], got[1])
+    pipe.close()
+    pipe2.close()
+
+
+def test_manager_async_save_and_wait(tmp_path):
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    for s in (1, 2, 3):
+        tr.step(x, y)
+        mgr.save(s, tr)                # async — returns immediately
+    mgr.wait()
+    assert mgr.checkpoints() == [1, 2, 3]
+    assert mgr.last_good_step == 3
+    assert mgr.age_seconds() is not None
+
+
+def test_async_writer_respawns_after_idle_queue(tmp_path):
+    """Regression (review): the writer thread exits when its queue
+    drains; a save scheduled right after must spawn a fresh writer —
+    never strand the job behind a dying-but-alive thread (wait() would
+    deadlock)."""
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    for s in (1, 2, 3):
+        mgr.save(s, tr)
+        mgr.wait(timeout=60)           # timeout: a deadlock fails loudly
+    assert mgr.checkpoints() == [1, 2, 3]
+
+
+def test_async_writer_backlog_sheds_oldest_pending(tmp_path):
+    """A writer slower than the save cadence sheds the oldest queued
+    snapshot (each pins a full on-device state copy) instead of
+    growing the backlog unboundedly; the newest save always lands."""
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path), keep_last_k=10)
+    chaos.configure({"checkpoint.write": {"every": 1, "action": "sleep",
+                                          "sleep_s": 0.25}})
+    try:
+        for s in range(1, 7):
+            mgr.save(s, tr)            # async, faster than the writer
+    finally:
+        mgr.wait(timeout=60)
+        chaos.disable()
+    ck = mgr.checkpoints()
+    assert 6 in ck                     # the newest save always commits
+    assert len(ck) < 6                 # older pending saves were shed
+
+
+def test_manager_retention_keep_last_k_and_every_n(tmp_path):
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path), keep_last_k=2,
+                                       keep_every_n=4)
+    for s in range(1, 9):
+        mgr.save(s, tr, sync=True)
+    # last 2 (7, 8) + every 4th (4, 8)
+    assert mgr.checkpoints() == [4, 7, 8]
+
+
+def test_torn_write_is_never_visible(tmp_path):
+    """A failure in the torn-write window (shards written, manifest
+    not) leaves only a .tmp directory — invisible to discovery, reaped
+    by the next retention pass."""
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    mgr.save(1, tr, sync=True)
+    chaos.configure({"checkpoint.commit": {"at_calls": [1]}})
+    with pytest.raises(resilience.InjectedFault):
+        mgr.save(2, tr, sync=True)
+    chaos.disable()
+    assert mgr.checkpoints() == [1]
+    assert mgr.newest_valid() == 1
+    leftovers = [d for d in os.listdir(str(tmp_path))
+                 if d.endswith(".tmp")]
+    assert leftovers == []             # failed write cleaned up
+    mgr.save(3, tr, sync=True)         # manager still healthy
+    assert mgr.newest_valid() == 3
+
+
+def test_kill_during_save_leaves_restorable_state(tmp_path):
+    """ISSUE 6 acceptance: a SIGKILL-equivalent (os._exit with no
+    cleanup) injected mid-checkpoint-write never corrupts restorable
+    state — the newest valid checkpoint always loads."""
+    payload = os.path.join(os.path.dirname(__file__),
+                           "chaos_kill_payload.py")
+    root = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, payload, root],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert proc.returncode == 7, proc.stderr[-2000:]
+    assert "UNREACHABLE" not in proc.stdout
+
+    # step 2 died in the torn-write window: only its .tmp dir may exist
+    assert os.path.isdir(os.path.join(root, "step-00000001"))
+    assert not os.path.isdir(os.path.join(root, "step-00000002"))
+
+    # the newest valid checkpoint restores, bit-exactly
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("chaos_kill_payload",
+                                                  payload)
+    payload_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(payload_mod)
+    tr, _ = payload_mod.build_trainer()
+    mgr = resilience.CheckpointManager(root)
+    assert mgr.newest_valid() == 1
+    assert mgr.restore_latest(tr) == 1
+    want = np.load(os.path.join(root, "params_at_1.npz"))
+    for n in tr.params:
+        np.testing.assert_array_equal(want[n], np.asarray(tr.params[n]))
+
+
+# ---------------------------------------------------------------------------
+# restore_sharded: checksum validation + fallback
+# ---------------------------------------------------------------------------
+def test_validate_sharded_catches_corruption_and_restore_falls_back(
+        tmp_path):
+    mx.random.seed(0)
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(str(tmp_path), keep_last_k=5)
+    mgr.save(1, tr, sync=True)
+    good = {n: np.asarray(v) for n, v in tr.params.items()}
+    tr.step(x, y)
+    mgr.save(2, tr, sync=True)
+
+    shard = os.path.join(mgr.step_dir(2), "ckpt.shards-0.npz")
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(blob))
+
+    with pytest.raises(parallel.CheckpointError):
+        parallel.validate_sharded(mgr.prefix(2))
+
+    # restore of the corrupt prefix falls back to the older sibling —
+    # and the trainer ends up with step-1 state, not garbage
+    tr2 = _trainer(seed=9)
+    restored = parallel.restore_sharded(mgr.prefix(2), tr2)
+    assert "step-00000001" in restored
+    for n in tr2.params:
+        np.testing.assert_array_equal(good[n], np.asarray(tr2.params[n]))
+
+    # no fallback candidates -> the original validation error surfaces,
+    # and the target trainer keeps its own state untouched
+    tr3 = _trainer(seed=9)
+    before = {n: np.asarray(v) for n, v in tr3.params.items()}
+    with pytest.raises(parallel.CheckpointError):
+        parallel.restore_sharded(mgr.prefix(2), tr3, fallback=None)
+    for n in tr3.params:
+        np.testing.assert_array_equal(before[n], np.asarray(tr3.params[n]))
+
+
+def test_validate_sharded_missing_manifest_and_shard_file(tmp_path):
+    tr = _trainer()
+    x, y = _batch()
+    tr.step(x, y)
+    prefix = str(tmp_path / "ck")
+    parallel.save_sharded(prefix, tr)
+    parallel.validate_sharded(prefix)          # whole -> passes
+    os.remove(prefix + ".shards-0.npz")
+    with pytest.raises(parallel.CheckpointError, match="missing shard"):
+        parallel.validate_sharded(prefix)
+    with pytest.raises(parallel.CheckpointError, match="no manifest"):
+        parallel.validate_sharded(str(tmp_path / "nothing"))
+
+
+def test_validate_sharded_accepts_pre_pr6_checkpoint_without_crc():
+    """Checkpoints written before the checksum field exist validate
+    structurally (the pinned round-4 compat artifact)."""
+    prefix = os.path.join(os.path.dirname(__file__), "compat",
+                          "pinned_mxtpu004_sharded")
+    manifest = parallel.validate_sharded(prefix)
+    assert manifest["magic"] == "MXTPU-SHARD-1"
+
+
+def test_save_sharded_manifest_carries_crc32(tmp_path):
+    tr = _trainer()
+    prefix = str(tmp_path / "c")
+    parallel.save_sharded(prefix, tr)
+    with open(prefix + ".manifest.json") as f:
+        manifest = json.load(f)
+    shards = [sh for e in manifest["tensors"].values()
+              for sh in e["shards"]]
+    assert shards and all("crc32" in sh for sh in shards)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: retry, restart, watchdog, preemption
+# ---------------------------------------------------------------------------
+def test_supervisor_plain_run_matches_unsupervised(tmp_path):
+    ref = _plain_run(10)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, checkpoint_every=4,
+                                backoff_base_s=0.001)
+    losses = sup.run(pipe, steps=10, start_step=0)
+    pipe.close()
+    assert losses == ref
+    assert mgr.newest_valid() == 10    # final sync checkpoint
+
+
+def test_supervisor_retries_transient_fault():
+    mx.random.seed(42)
+    ref = _plain_run(8)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    sup = resilience.Supervisor(tr, None, backoff_base_s=0.001)
+    chaos.configure({"step": {"at_calls": [3], "transient": True}})
+    losses = sup.run(pipe, steps=8)
+    chaos.disable()
+    pipe.close()
+    assert sup.retries == 1
+    assert losses == ref               # retried step is bit-identical
+
+
+def test_supervisor_restart_is_bit_exact_through_pipeline(tmp_path):
+    """ISSUE 6 acceptance: training resumed from a checkpoint after a
+    fatal failure reproduces the uninterrupted run's loss sequence
+    exactly (through shuffle + shard + prefetch)."""
+    ref = _plain_run(12)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, checkpoint_every=3,
+                                backoff_base_s=0.001)
+    chaos.configure({"step": {"at_calls": [8], "transient": False}})
+    losses = sup.run(pipe, steps=12, start_step=0)
+    chaos.disable()
+    pipe.close()
+    assert sup.restarts == 1
+    assert losses == ref
+
+
+def test_supervisor_retries_exhausted_escalates_to_restart(tmp_path):
+    ref = _plain_run(10)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, checkpoint_every=2,
+                                max_retries=2, backoff_base_s=0.001)
+    # transient fault that keeps firing: retries exhaust, restart wins
+    chaos.configure({"step": {"at_calls": [7, 8, 9],
+                              "transient": True}})
+    losses = sup.run(pipe, steps=10, start_step=0)
+    chaos.disable()
+    pipe.close()
+    assert sup.retries == 2 and sup.restarts == 1
+    assert losses == ref
+
+
+def test_supervisor_fatal_without_manager_reraises():
+    tr = _trainer()
+    pipe = _pipe()
+    sup = resilience.Supervisor(tr, None, backoff_base_s=0.001)
+    chaos.configure({"step": {"at_calls": [2], "transient": False}})
+    with pytest.raises(resilience.InjectedFault):
+        sup.run(pipe, steps=5)
+    chaos.disable()
+    pipe.close()
+
+
+def test_supervisor_restart_budget_exhausts():
+    tr = _trainer()
+    pipe = _pipe()
+    sup = resilience.Supervisor(tr, None, max_restarts=0,
+                                backoff_base_s=0.001)
+    chaos.configure({"step": {"every": 2, "transient": False}})
+    with pytest.raises(resilience.InjectedFault):
+        sup.run(pipe, steps=6)
+    chaos.disable()
+    pipe.close()
+
+
+def test_hung_step_watchdog_interrupts_and_retries():
+    mx.random.seed(42)
+    ref = _plain_run(8)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    sup = resilience.Supervisor(tr, None, enforce_deadline=True,
+                                min_deadline_s=0.3,
+                                watchdog_multiplier=5.0,
+                                backoff_base_s=0.001)
+    chaos.configure({"step.slow": {"at_calls": [5], "action": "sleep",
+                                   "sleep_s": 30.0, "max_fires": 1}})
+    t0 = time.time()
+    losses = sup.run(pipe, steps=8)
+    chaos.disable()
+    pipe.close()
+    assert time.time() - t0 < 20.0     # the 30s sleep was interrupted
+    assert sup.hung_steps == 1 and sup.retries == 1
+    assert losses == ref
+
+
+def test_data_worker_death_recovers_via_retry_mid_epoch():
+    """ISSUE 6 acceptance (c): a data worker dying mid-epoch surfaces
+    at next(), is retried, and the run completes with the exact stream
+    (the prefetch producer resumes from the failure point)."""
+    ref = _plain_run(10)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    sup = resilience.Supervisor(tr, None, backoff_base_s=0.001)
+    chaos.configure({"data.worker": {"at_calls": [3]}})
+    losses = sup.run(pipe, steps=10)
+    chaos.disable()
+    pipe.close()
+    assert sup.retries >= 1
+    assert losses == ref
+
+
+def test_device_prefetcher_worker_death_resumes_exact_stream():
+    """The DevicePrefetcher honors the same retry contract as the host
+    prefetch stage: a propagated producer failure resumes the epoch at
+    the failure point (counters intact), not at a fresh epoch."""
+    mx.random.seed(42)
+    tr = _trainer()
+    ref_feed = tr.device_prefetcher(_pipe())
+    ref = []
+    it = iter(ref_feed)
+    for _ in range(10):
+        try:
+            b = next(it)
+        except StopIteration:
+            it = iter(ref_feed)
+            b = next(it)
+        ref.append(float(tr.step(*b)))
+    ref_feed.close()
+
+    mx.random.seed(42)
+    tr2 = _trainer()
+    feed = tr2.device_prefetcher(_pipe())
+    sup = resilience.Supervisor(tr2, None, backoff_base_s=0.001)
+    chaos.configure({"data.worker": {"at_calls": [4]}})
+    losses = sup.run(feed, steps=10)
+    chaos.disable()
+    feed.close()
+    assert sup.retries >= 1
+    assert losses == ref
+
+
+def test_preemption_sigterm_checkpoints_and_exits(tmp_path):
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr)
+    sup.install_preemption_handler()
+    try:
+        orig_step = tr.step
+
+        def stepper(*args):
+            if sup.step_num == 3:      # preemption notice mid-run
+                os.kill(os.getpid(), signal.SIGTERM)
+            return orig_step(*args)
+
+        sup._step_fn = stepper
+        with pytest.raises(resilience.Preempted) as ei:
+            sup.run(pipe, steps=50)
+    finally:
+        sup.uninstall_preemption_handler()
+        pipe.close()
+    assert ei.value.step == 4          # the in-flight step completed
+    assert mgr.newest_valid() == 4     # final synchronous checkpoint
+
+
+def test_resume_after_preemption_is_bit_exact(tmp_path):
+    ref = _plain_run(10)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr)
+    sup.request_preemption()           # notice before the run starts:
+    with pytest.raises(resilience.Preempted):
+        sup.run(pipe, steps=10, start_step=0)     # ckpt at step 0
+    pipe.close()
+
+    # a fresh process resumes from the checkpoint (start_step=None)
+    mx.random.seed(1234)               # resume must NOT depend on this
+    tr2 = _trainer(seed=77)
+    pipe2 = _pipe()
+    mgr2 = resilience.CheckpointManager(str(tmp_path))
+    sup2 = resilience.Supervisor(tr2, mgr2)
+    losses = sup2.run(pipe2, steps=10)
+    pipe2.close()
+    assert losses == ref
+
+
+def test_resume_mid_stream_in_fresh_process_continues_bit_exact(tmp_path):
+    """A run killed after a mid-stream checkpoint resumes in a 'fresh
+    process' (new trainer/pipeline/supervisor objects): steps executed
+    by the dead incarnation report NaN; everything from the restored
+    step on matches the uninterrupted reference exactly."""
+    ref = _plain_run(10)
+    mx.random.seed(42)
+    tr = _trainer()
+    pipe = _pipe()
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, checkpoint_every=5,
+                                final_checkpoint=False,
+                                backoff_base_s=0.001)
+    # "die" at step 7: a fatal with no restart budget kills the run,
+    # leaving the step-5 checkpoint as last-good
+    sup.max_restarts = 0
+    chaos.configure({"step": {"at_calls": [8], "transient": False}})
+    with pytest.raises(resilience.InjectedFault):
+        sup.run(pipe, steps=10, start_step=0)
+    chaos.disable()
+    pipe.close()
+    mgr.wait()                         # let the async step-5 save land
+    assert mgr.newest_valid() == 5
+
+    mx.random.seed(777)                # resume must not depend on this
+    tr2 = _trainer(seed=31)
+    pipe2 = _pipe()
+    mgr2 = resilience.CheckpointManager(str(tmp_path))
+    sup2 = resilience.Supervisor(tr2, mgr2)
+    losses = sup2.run(pipe2, steps=10)           # start_step=None
+    pipe2.close()
+    assert all(np.isnan(v) for v in losses[:5])  # died with process 1
+    assert losses[5:] == ref[5:]                 # bit-exact continuation
+
+
+def test_supervisor_emits_resilience_telemetry(tmp_path):
+    from incubator_mxnet_tpu import telemetry
+
+    telemetry.reset()
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.set_jsonl(sink)
+    try:
+        mx.random.seed(42)
+        tr = _trainer()
+        pipe = _pipe()
+        mgr = resilience.CheckpointManager(str(tmp_path / "ck"))
+        sup = resilience.Supervisor(tr, mgr, checkpoint_every=3,
+                                    backoff_base_s=0.001)
+        chaos.configure({"step": {"at_calls": [2], "transient": True}})
+        sup.run(pipe, steps=6, start_step=0)
+        chaos.disable()
+        pipe.close()
+        text = telemetry.prometheus_text(telemetry.get_registry())
+        assert "mxtpu_resilience_retries_total" in text
+        assert "mxtpu_resilience_checkpoints_total" in text
+        assert "mxtpu_chaos_injected_total" in text
+        records = telemetry.read_jsonl(sink)
+        kinds = {r.get("event") for r in records
+                 if r.get("kind") == "resilience"}
+        assert "retry" in kinds and "checkpoint" in kinds
+    finally:
+        telemetry.reset()
+
+
+def test_telemetry_report_shows_resilience_section(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import telemetry_report
+
+    sink = str(tmp_path / "r.jsonl")
+    with open(sink, "w") as f:
+        for rec in (
+                {"kind": "resilience", "event": "checkpoint", "step": 5,
+                 "ms": 12.5},
+                {"kind": "resilience", "event": "retry", "step": 6,
+                 "where": "step", "attempt": 1},
+                {"kind": "resilience", "event": "restart",
+                 "from_step": 7, "to_step": 5},
+                {"kind": "resilience", "event": "checkpoint_failed",
+                 "step": 8, "error": "torn"}):
+            f.write(json.dumps(rec) + "\n")
+    out = telemetry_report.summarize(sink)
+    assert "resilience:" in out
+    assert "retry=1" in out and "restart=1" in out
+    assert "checkpoint latency" in out
+    assert "1 checkpoint write(s) failed" in out
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+def test_chaos_schedule_is_deterministic():
+    for _ in range(2):
+        chaos.configure({"step": {"prob": 0.5, "max_fires": 3}}, seed=123)
+        fired = []
+        for call in range(1, 21):
+            try:
+                chaos.maybe_inject("step")
+            except resilience.InjectedFault as e:
+                fired.append(e.call)
+        chaos.disable()
+        if _ == 0:
+            first = fired
+    assert first == fired and len(first) == 3
+
+
+def test_chaos_fatal_calls_and_events():
+    chaos.configure({"step": {"at_calls": [2], "fatal_calls": [4]}})
+    outcomes = []
+    for _ in range(5):
+        try:
+            chaos.maybe_inject("step", detail="t")
+            outcomes.append(None)
+        except resilience.InjectedFault as e:
+            outcomes.append(e.transient)
+    ev = chaos.events()
+    chaos.disable()
+    assert outcomes == [None, True, None, False, None]
+    assert [e["call"] for e in ev] == [2, 4]
+    assert chaos.events() == []        # disable clears the plan
+
+
+def test_chaos_unknown_spec_key_rejected():
+    with pytest.raises(ValueError, match="unknown keys"):
+        chaos.configure({"step": {"at_call": [1]}})
+
+
+def test_chaos_configure_from_env():
+    from incubator_mxnet_tpu.config import config
+
+    config.set("MXTPU_CHAOS",
+               '{"seed": 5, "sites": {"step": {"at_calls": [1]}}}')
+    try:
+        plan = chaos.configure_from_env()
+        assert plan is not None and plan.seed == 5
+        with pytest.raises(resilience.InjectedFault):
+            chaos.maybe_inject("step")
+    finally:
+        config.unset("MXTPU_CHAOS")
+        chaos.disable()
+    config.set("MXTPU_CHAOS", "")
+    try:
+        assert chaos.configure_from_env() is None
+    finally:
+        config.unset("MXTPU_CHAOS")
+
+
+# ---------------------------------------------------------------------------
+# RNG state round-trip
+# ---------------------------------------------------------------------------
+def test_random_state_roundtrip_restores_key_sequence():
+    mx.random.seed(31)
+    mx.random.next_key()
+    state = mx.random.get_state()
+    a = [np.asarray(mx.random.next_key()).tolist() for _ in range(3)]
+    mx.random.seed(999)                # clobber
+    mx.random.set_state(state)
+    b = [np.asarray(mx.random.next_key()).tolist() for _ in range(3)]
+    assert a == b
+    assert json.loads(json.dumps(state)) == state    # JSON-able

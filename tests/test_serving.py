@@ -268,6 +268,120 @@ def test_runner_failure_propagates_to_futures():
 
 
 # ---------------------------------------------------------------------------
+# graceful degradation (docs/RESILIENCE.md serving section)
+# ---------------------------------------------------------------------------
+def test_deadline_sheds_aged_requests_with_retry_after():
+    """Requests older than deadline_ms at flush time fail with
+    DeadlineExceededError instead of being served late (and instead of
+    occupying batch slots) — counted in metrics.shed."""
+    from incubator_mxnet_tpu.serving import DeadlineExceededError
+
+    gate = threading.Event()
+
+    def slow_runner(batch):
+        gate.wait(0.4)                 # one slow in-flight batch
+        return batch
+
+    b = DynamicBatcher(slow_runner, max_batch_size=1, max_wait_ms=1.0,
+                       max_queue=16, deadline_ms=50.0, name="shed")
+    try:
+        futs = [b.submit(np.ones(3, np.float32)) for _ in range(6)]
+        served = shed = 0
+        for f in futs:
+            try:
+                f.result(timeout=15)
+                served += 1
+            except DeadlineExceededError as e:
+                shed += 1
+                assert e.retry_after >= 0.0
+        assert served >= 1 and shed >= 1
+        assert b.metrics.shed == shed
+        # the batcher keeps serving fresh traffic after shedding
+        gate.set()
+        assert b.submit(np.ones(3, np.float32)).result(timeout=10) \
+            .shape == (3,)
+    finally:
+        b.close()
+
+
+def test_no_deadline_means_no_shedding():
+    b = DynamicBatcher(lambda x: x, max_batch_size=4, max_wait_ms=1.0)
+    try:
+        assert b.deadline_ms is None
+        futs = [b.submit(np.ones(2, np.float32)) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=10)
+        assert b.metrics.shed == 0
+    finally:
+        b.close()
+
+
+def test_drain_timeout_force_closes_wedged_batch():
+    """ISSUE 6 satellite: drain() gains a timeout — a wedged in-flight
+    batch can't hang shutdown forever; the force-close is warned and
+    counted in mxtpu_serving_forced_close_total."""
+    stuck = threading.Event()
+    srv = ModelServer(_dense(inp=4), buckets=(1,), max_wait_ms=1.0,
+                      name="wedged")
+    real_runner = srv._batcher._runner
+    srv._batcher._runner = lambda batch: (stuck.wait(),
+                                          real_runner(batch))[1]
+    try:
+        srv.submit(np.ones(4, np.float32))
+        time.sleep(0.05)               # let the worker pick it up
+        t0 = time.time()
+        assert srv.drain(timeout=0.5) is False
+        assert time.time() - t0 < 5.0  # no 5s worker-join tail
+        assert srv.metrics.forced_closes == 1
+        with pytest.raises(ServerClosedError):
+            srv.submit(np.ones(4, np.float32))
+    finally:
+        stuck.set()
+        srv.close()
+
+
+def test_healthz_flips_during_drain_and_maintenance():
+    srv = ModelServer(_dense(inp=4), buckets=(1, 2), max_wait_ms=1.0,
+                      name="probe")
+    try:
+        hz = srv.healthz()
+        assert hz["ready"] is True and hz["state"] == "running"
+        with srv.maintenance():        # hot-restore window
+            hz = srv.healthz()
+            assert hz["ready"] is False and hz["maintenance"] is True
+            # traffic is still served while unready (drain-before-route)
+            out = srv.predict(np.ones(4, np.float32))
+            assert np.asarray(out).shape == (3,)
+        assert srv.healthz()["ready"] is True
+        srv.drain(timeout=10.0)
+        hz = srv.healthz()
+        assert hz["ready"] is False and hz["state"] != "running"
+    finally:
+        srv.close()
+
+
+def test_server_deadline_param_reaches_batcher():
+    srv = ModelServer(_dense(inp=4), buckets=(1,), deadline_ms=125.0,
+                      name="dl")
+    try:
+        assert srv._batcher.deadline_ms == 125.0
+    finally:
+        srv.close()
+    # knob-driven default
+    from incubator_mxnet_tpu.config import config
+
+    config.set("MXTPU_SERVING_DEADLINE_MS", 80.0)
+    try:
+        srv2 = ModelServer(_dense(inp=4), buckets=(1,), name="dl2")
+        try:
+            assert srv2._batcher.deadline_ms == 80.0
+        finally:
+            srv2.close()
+    finally:
+        config.unset("MXTPU_SERVING_DEADLINE_MS")
+
+
+# ---------------------------------------------------------------------------
 # ModelServer end to end
 # ---------------------------------------------------------------------------
 def test_server_concurrent_clients_match_unbatched():

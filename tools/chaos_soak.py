@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Chaos soak: N supervised training steps under a seeded fault schedule.
+
+Runs the deterministic CPU config (small SPMD MLP + a shuffle/shard/
+batch ``mxtpu.data`` pipeline) twice:
+
+1. **reference** — uninterrupted, chaos off: the ground-truth loss
+   stream;
+2. **soak** — the same seeds under a :class:`resilience.Supervisor` +
+   :class:`CheckpointManager` with the fault plan active (default: a
+   transient step fault, a fatal step fault, a slow step, a torn
+   checkpoint write, and a data-worker death — every chaos site in the
+   catalog fires at least once).
+
+The soak must (a) complete all N steps and (b) reproduce the reference
+loss stream **exactly** — restarts rewind model, optimizer, input
+position and RNG together, so any drift is a recovery bug. Exits
+nonzero on any non-recovered failure or loss mismatch; emits a
+``kind: "resilience"`` JSONL summary through the PR 4 sink
+(``--jsonl`` / ``MXTPU_TELEMETRY_JSONL``), so
+``tools/telemetry_report.py`` shows the soak next to its retry/restart/
+checkpoint records.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --steps 60 \
+        --ckpt-every 10 --jsonl soak.jsonl
+    python tools/telemetry_report.py soak.jsonl
+
+A custom plan rides ``--plan`` (JSON) or the ``MXTPU_CHAOS`` knob.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PLAN = {
+    # transient step fault: retried in place
+    "step": {"at_calls": [4], "transient": True},
+    # slow step: trips the (enforcing) hung-step watchdog, then retried.
+    # fires once (max_fires) so the retry itself is clean
+    "step.slow": {"at_calls": [9], "action": "sleep", "sleep_s": 3.0,
+                  "max_fires": 1},
+    # torn checkpoint write: the save fails, training continues, and the
+    # NEXT save commits — a later restart restores that one
+    "checkpoint.commit": {"at_calls": [2]},
+    # data worker death: surfaces at next(feed), retried without
+    # consuming a sample
+    "data.worker": {"at_calls": [30]},
+}
+#: a fatal step fault is scheduled relative to --steps (after the first
+#: checkpoint) in main(), so the restart path always runs
+
+
+def build(seed: int):
+    """Deterministic trainer + pipeline (fresh instances per run)."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu import data as mxdata
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=16, activation="relu"),
+            nn.Dense(8, in_units=32))
+    net.initialize(init="xavier")
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}))
+    rs = np.random.RandomState(seed + 1)
+    x = rs.rand(256, 16).astype(np.float32)
+    y = rs.randint(0, 8, (256,)).astype(np.float32)
+    pipe = (mxdata.from_ndarray(x, y)
+            .shuffle(64, seed=seed)
+            .shard(0, 1)
+            .batch(16)
+            .prefetch(2))
+    return trainer, pipe
+
+
+def reference_run(steps: int, seed: int):
+    trainer, pipe = build(seed)
+    losses, it = [], iter(pipe)
+    for _ in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(pipe)
+            batch = next(it)
+        losses.append(float(trainer.step(*batch)))
+    pipe.close()
+    return losses
+
+
+def soak_run(steps: int, seed: int, ckpt_every: int, root: str,
+             plan: dict, plan_seed: int):
+    from incubator_mxnet_tpu import resilience
+
+    trainer, pipe = build(seed)
+    mgr = resilience.CheckpointManager(root, keep_last_k=3)
+    sup = resilience.Supervisor(trainer, mgr, checkpoint_every=ckpt_every,
+                                enforce_deadline=True, min_deadline_s=0.5,
+                                backoff_base_s=0.01, seed=plan_seed)
+    resilience.chaos.configure(plan, seed=plan_seed)
+    try:
+        losses = sup.run(pipe, steps=steps, start_step=0)
+    finally:
+        events = resilience.chaos.events()   # before disable clears them
+        resilience.chaos.disable()
+        pipe.close()
+    return losses, sup, events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--plan", type=str, default=None,
+                    help="JSON chaos plan (default: the built-in "
+                         "all-sites schedule; MXTPU_CHAOS also accepted)")
+    ap.add_argument("--root", type=str, default=None,
+                    help="checkpoint root (default: a fresh tmp dir)")
+    ap.add_argument("--jsonl", type=str, default=None,
+                    help="telemetry JSONL sink path")
+    args = ap.parse_args(argv)
+
+    if args.jsonl:
+        os.environ["MXTPU_TELEMETRY_JSONL"] = args.jsonl
+    if args.plan:
+        plan = json.loads(args.plan)
+    elif os.environ.get("MXTPU_CHAOS", "").strip():
+        data = json.loads(os.environ["MXTPU_CHAOS"])
+        plan = data.get("sites", data)
+    else:
+        plan = {k: dict(v) for k, v in DEFAULT_PLAN.items()}
+        # a fatal step fault lands after the first checkpoint commits,
+        # so the soak always exercises a real restore-from-checkpoint
+        # (the call at 4 stays transient: before any checkpoint exists
+        # a fatal would end the run)
+        plan["step"]["fatal_calls"] = [max(args.ckpt_every + 3, 6)]
+
+    root = args.root or tempfile.mkdtemp(prefix="mxtpu-chaos-soak-")
+    own_root = args.root is None
+
+    print(f"[chaos_soak] reference run: {args.steps} steps", flush=True)
+    ref = reference_run(args.steps, args.seed)
+    print(f"[chaos_soak] soak run under plan: {json.dumps(plan)}",
+          flush=True)
+    failure = None
+    losses = sup = events = None
+    try:
+        losses, sup, events = soak_run(args.steps, args.seed,
+                                       args.ckpt_every, root, plan,
+                                       plan_seed=args.seed)
+    except BaseException as e:      # noqa: BLE001 — report, don't crash
+        failure = f"soak did not complete: {type(e).__name__}: {e}"
+
+    mismatches = 0
+    if failure is None:
+        mismatches = sum(1 for a, b in zip(ref, losses) if a != b)
+        if len(losses) != len(ref):
+            failure = (f"soak produced {len(losses)} losses, "
+                       f"expected {len(ref)}")
+        elif mismatches:
+            failure = (f"{mismatches}/{len(ref)} losses differ from the "
+                       "uninterrupted reference (recovery is not "
+                       "bit-exact)")
+
+    summary = {
+        "kind": "resilience", "event": "soak_summary",
+        "steps": args.steps, "ok": failure is None,
+        "faults_injected": len(events or []),
+        "fault_log": events or [],
+        "retries": getattr(sup, "retries", None),
+        "restarts": getattr(sup, "restarts", None),
+        "hung_steps": getattr(sup, "hung_steps", None),
+        "loss_mismatches": mismatches,
+    }
+    if failure:
+        summary["failure"] = failure
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.jsonl_emit(summary)
+    except Exception:
+        pass
+    print(json.dumps(summary))
+    if own_root:
+        shutil.rmtree(root, ignore_errors=True)
+    if failure:
+        print(f"[chaos_soak] FAIL: {failure}", file=sys.stderr)
+        return 1
+    print(f"[chaos_soak] OK: {args.steps} steps, "
+          f"{summary['faults_injected']} faults injected, "
+          f"{summary['retries']} retries, {summary['restarts']} "
+          "restarts, loss stream bit-exact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,29 @@ def summarize(path: str, merge: bool = False) -> str:
                 f"{site:24s} {max(r.get('batches', 0) for r in recs):8d} "
                 f"{(f'{bounds[-1]:.1f}' if bounds else '-'):>13s} "
                 f"{sum(1 for r in recs if r.get('epoch_end')):7d}")
+    res = [r for r in records if r.get("kind") == "resilience"]
+    if res:
+        counts: Dict[str, int] = {}
+        for r in res:
+            ev = r.get("event", "?")
+            counts[ev] = counts.get(ev, 0) + 1
+        ck_ms = sorted(r["ms"] for r in res
+                       if r.get("event") == "checkpoint" and "ms" in r)
+        lines.append("")
+        lines.append("resilience: " + ", ".join(
+            f"{ev}={n}" for ev, n in sorted(counts.items())))
+        if ck_ms:
+            last_step = max(r.get("step", 0) for r in res
+                            if r.get("event") == "checkpoint")
+            lines.append(
+                f"  checkpoint latency p50 {_pctl(ck_ms, 50):.1f} ms / "
+                f"p95 {_pctl(ck_ms, 95):.1f} ms "
+                f"({len(ck_ms)} committed, last good step {last_step})")
+        bad = counts.get("checkpoint_failed", 0)
+        if bad:
+            lines.append(f"  !! {bad} checkpoint write(s) failed before "
+                         "commit (torn writes are never visible; see "
+                         "docs/RESILIENCE.md)")
     bench = [r for r in records if r.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -194,6 +217,18 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
         if r.get("kind") == "data" and "input_bound_pct" in r:
             out[f"data/{r.get('site', '?')}/input_bound_pct"] = \
                 float(r["input_bound_pct"])
+    res_counts: Dict[str, int] = {}
+    ck_ms: List[float] = []
+    for r in records:
+        if r.get("kind") == "resilience":
+            ev = r.get("event", "?")
+            res_counts[ev] = res_counts.get(ev, 0) + 1
+            if ev == "checkpoint" and "ms" in r:
+                ck_ms.append(float(r["ms"]))
+    for ev, n in res_counts.items():
+        out[f"resilience/{ev}"] = float(n)
+    if ck_ms:
+        out["resilience/checkpoint_p50_ms"] = _pctl(sorted(ck_ms), 50)
     return out
 
 
