@@ -46,6 +46,10 @@ ANCHORS = {
     # acceptance: < 5%); vs_baseline = fraction of the budget consumed,
     # so < 1.0 is within budget (lower is better on this row)
     "resilience": 5.0,
+    # peak-host-bytes reduction of the planned-slice reshard restore vs
+    # the full-gather rebuild (benchmark/reshard_bench.py); anchor 1.0 =
+    # no better than gathering, so vs_baseline IS the reduction factor
+    "reshard": 1.0,
     "resnet50": 800.0,
 }
 
@@ -438,6 +442,56 @@ def bench_resilience():
             "resilience_async_ckpt_overhead_pct", "resilience", None)
 
 
+def bench_reshard():
+    """config[7]: topology-portable restore — planned-slice reshard vs
+    the full-gather rebuild restoring a ZeRO-sharded checkpoint onto a
+    different mesh shape (benchmark/reshard_bench.py). The recorded
+    value is the peak-host-bytes reduction factor on the largest
+    destination-SHARDED tensor (its full size / the engine's largest
+    host buffer for it — the ZeRO-1 optimizer state here); anchor 1.0,
+    so ``vs_baseline`` IS the reduction. No MFU row — the metric is
+    restore memory, not chip FLOPs. Wall times and bytes ride the
+    JSONL mirror.
+
+    The row needs a multi-device mesh to have anything to reshard
+    BETWEEN; on a single-chip host it runs on the virtual CPU mesh (8
+    devices, the tests/conftest.py harness) — the metric is host-side
+    restore memory, which the CPU backend measures faithfully."""
+    import os
+    import sys
+
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.reshard_bench import compare_restore
+
+    out = compare_restore()
+    if out["peak_host_bytes"] <= 0:
+        raise RuntimeError("reshard restore read nothing")
+    _jsonl_emit({"kind": "bench", "metric": "reshard_restore_detail",
+                 **{k: out[k] for k in ("gather_ms", "planned_ms",
+                                        "bytes_read", "plan_ops",
+                                        "peak_host_bytes",
+                                        "biggest_tensor_bytes",
+                                        "sharded_tensor_bytes",
+                                        "sharded_tensor_peak_bytes",
+                                        "save_devices",
+                                        "restore_devices")}})
+    return (out["peak_reduction_x"], "x_peak_host_bytes_reduction",
+            "reshard_peak_host_reduction", "reshard", None)
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "lstm_ptb": bench_lstm_ptb,
@@ -445,6 +499,7 @@ CONFIGS = {
     "ssd300": bench_ssd,
     "data_pipeline": bench_data_pipeline,
     "resilience": bench_resilience,
+    "reshard": bench_reshard,
     "resnet50": bench_resnet,  # headline — always last
 }
 

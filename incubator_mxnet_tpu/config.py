@@ -300,6 +300,32 @@ config.register(
     "injection; production code paths pay one attribute load per "
     "registered site.")
 config.register(
+    "MXTPU_RESHARD_MODE", "auto", str,
+    "When restore_sharded engages the slice-planning reshard engine "
+    "(parallel/reshard.py): 'auto' (default) only when the manifest's "
+    "recorded save topology differs from the live mesh, 'always' for "
+    "every restore, 'never' to force the legacy full-gather rebuild "
+    "(docs/RESILIENCE.md 'Elastic restart').")
+config.register(
+    "MXTPU_RESHARD_HOST_BUDGET_MB", 0.0, float,
+    "Soft per-tensor peak-host-bytes budget for resharded restores: the "
+    "engine holds ONE destination-shard buffer at a time, so peak = the "
+    "largest destination shard; a tensor whose single shard exceeds "
+    "this is warned and counted (mxtpu_reshard_budget_exceeded_total) — "
+    "shard the tensor finer or restore on more hosts. 0 (default) "
+    "disables the check.")
+config.register(
+    "MXTPU_RESHARD_MAX_OPEN_FILES", 8, int,
+    "How many .shards-{rank}.npz files a restore/validation may hold "
+    "open at once (LRU-evicted beyond it) — an M=1 restore of a "
+    "many-host checkpoint touches every rank's file and must not "
+    "exhaust file handles.")
+config.register(
+    "MXTPU_ELASTIC_MAX_INCARNATIONS", 3, int,
+    "How many times resilience.ElasticRunner may rebuild the trainer on "
+    "a surviving topology (fresh build_fn + reshard-restore) after a "
+    "fatal incarnation loss before re-raising.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

@@ -34,11 +34,14 @@ new code should compose these stages.
 from .pipeline import Stage, from_iter, from_ndarray, from_recordio
 from .device_prefetch import DevicePrefetcher, device_prefetcher
 from .state import (iterator_state, load_iterator_state,
-                    load_iterator_state_file, save_iterator_state_file)
+                    load_iterator_state_file, reshard_iterator_state,
+                    reshard_iterator_states, restore_sidecars,
+                    save_iterator_state_file)
 
 __all__ = [
     "DevicePrefetcher", "Stage", "device_prefetcher", "from_iter",
     "from_ndarray", "from_recordio", "iterator_state",
     "load_iterator_state", "load_iterator_state_file",
-    "save_iterator_state_file",
+    "reshard_iterator_state", "reshard_iterator_states",
+    "restore_sidecars", "save_iterator_state_file",
 ]

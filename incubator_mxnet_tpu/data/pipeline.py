@@ -522,6 +522,12 @@ class _Shard(Stage):
         self.shard_index = shard_index
         self.shard_count = shard_count
 
+    def _own_state(self):
+        # recorded so data.state can re-partition the global sample
+        # position when a checkpoint restores at a different rank count
+        return {"shard_index": self.shard_index,
+                "shard_count": self.shard_count}
+
     def _next(self):
         src = self._source
         if self._cursor == 0:
@@ -552,6 +558,11 @@ class _Batch(Stage):
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.batch_size = batch_size
         self.drop_last = drop_last
+
+    def _own_state(self):
+        # the sample-granularity conversion factor data.state needs to
+        # compute global sample position across topology changes
+        return {"batch_size": self.batch_size}
 
     def _next(self):
         items = []

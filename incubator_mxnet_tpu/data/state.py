@@ -18,13 +18,21 @@ a different shard of the input stream.
 
 from __future__ import annotations
 
+import glob
 import json
-from typing import Any, Dict, Optional
+import logging
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
 
 __all__ = ["iterator_state", "load_iterator_state",
-           "save_iterator_state_file", "load_iterator_state_file"]
+           "load_iterator_state_file", "reshard_iterator_state",
+           "reshard_iterator_states", "restore_sidecars",
+           "save_iterator_state_file"]
 
 _MAGIC = "MXTPU-DATA-1"
+
+_log = logging.getLogger("mxtpu.data")
 
 
 def iterator_state(it) -> Dict[str, Any]:
@@ -63,3 +71,296 @@ def _jsonable(obj):
         except (TypeError, ValueError):
             continue
     return str(obj)
+
+
+# ---------------------------------------------------------------------------
+# N -> M sidecar resharding (PR 7, docs/RESILIENCE.md "Elastic restart")
+# ---------------------------------------------------------------------------
+# A pipeline's sample stream is rank-count invariant below the shard
+# stage: shuffle/map/sources run identically on every rank (same seeds,
+# same epoch), and ``shard`` merely DEALS the stream round-robin at its
+# granularity. So an elastic restart only has to re-partition the
+# **global sample position** — how many post-shuffle samples the whole
+# job consumed this epoch — over the new rank count, and fast-forward
+# each new pipeline to its slice of that position. The invariance
+# contract checked here: one shard stage per chain, no shuffle
+# downstream of it, and the same stage kinds (ignoring batch/shard/
+# prefetch placement) on both sides of the topology change.
+
+#: stage kinds that neither change sample granularity nor depend on the
+#: rank count — ignored when comparing chain structure across topologies
+_NEUTRAL_KINDS = ("batch", "shard", "prefetch", "device_prefetch")
+
+
+def _state_chain(sd: Dict[str, Any]) -> List[Dict[str, Any]]:
+    out = []
+    node: Optional[Dict[str, Any]] = sd
+    while node is not None:
+        out.append(node)
+        node = node.get("source")
+    return out
+
+
+def _stage_chain(stage) -> List[Any]:
+    out = []
+    while stage is not None:
+        out.append(stage)
+        stage = getattr(stage, "_source", None)
+    return out
+
+
+def _unwrap_target(it):
+    """(top pipeline Stage, wrap) — ``wrap(cursor, inner_sd)`` builds
+    the state dict the target object actually loads (DevicePrefetcher
+    wraps the pipeline's state with its own delivered-cursor)."""
+    from .device_prefetch import DevicePrefetcher
+
+    if isinstance(it, DevicePrefetcher):
+        def wrap(cursor: int, inner: Dict[str, Any]) -> Dict[str, Any]:
+            return {"kind": "device_prefetch", "cursor": cursor,
+                    "source": inner}
+
+        return it._source, wrap
+    return it, lambda _cursor, inner: inner
+
+
+def _chain_info(chain: Sequence[Dict[str, Any]], what: str):
+    """(samples_per_top_item, shard_node_or_None, batches_above,
+    batches_below, reduced_kinds) for a state chain, validating the
+    invariance contract."""
+    mult = 1
+    shard = None
+    above = 1
+    below = 1
+    kinds = []
+    shuffle_above_shard = False
+    for node in chain:
+        kind = node.get("kind")
+        if kind == "device_prefetch":
+            continue
+        if kind not in _NEUTRAL_KINDS:
+            kinds.append(kind)
+        if kind == "batch":
+            if "batch_size" not in node:
+                raise ValueError(
+                    f"{what}: batch stage state carries no batch_size — "
+                    "sidecar predates topology-portable resharding; "
+                    "restore on the saving rank count instead")
+            b = int(node["batch_size"])
+            mult *= b
+            if shard is None:
+                above *= b
+            else:
+                below *= b
+        elif kind == "shard":
+            if shard is not None:
+                raise ValueError(
+                    f"{what}: more than one shard stage — the global "
+                    "sample position is ambiguous; reshard supports "
+                    "exactly one shard per chain")
+            shard = node
+        elif kind == "shuffle" and shard is None:
+            # downstream of a shard IF one appears further along the
+            # (top -> source) walk; a shard-less chain is fine
+            shuffle_above_shard = True
+    if shard is not None and shuffle_above_shard:
+        raise ValueError(
+            f"{what}: shuffle downstream of shard — the per-rank "
+            "streams diverge, so the position cannot be "
+            "re-partitioned across a rank-count change")
+    return mult, shard, above, below, kinds
+
+
+def _live_chain_states(stages: Sequence[Any]) -> List[Dict[str, Any]]:
+    """Per-stage ``{kind, own-state}`` template nodes for a LIVE chain
+    (no cursors — the caller sets epoch and the top cursor)."""
+    nodes = []
+    for st in stages:
+        node = {"kind": st.kind, "epoch": 0, "cursor": 0}
+        node.update(st._own_state())
+        nodes.append(node)
+    for parent, child in zip(nodes, nodes[1:]):
+        parent["source"] = child
+    return nodes
+
+
+def _chain_epoch(chain: Sequence[Dict[str, Any]]) -> int:
+    """The chain's epoch: the first node that records one (the
+    DevicePrefetcher wrapper node doesn't)."""
+    for node in chain:
+        if "epoch" in node:
+            return int(node["epoch"])
+    raise ValueError("pipeline state records no epoch")
+
+
+def reshard_iterator_state(states: Sequence[Dict[str, Any]],
+                           it) -> None:
+    """Restore ``it`` (a fresh pipeline — or :class:`DevicePrefetcher` —
+    for ONE new rank) from the ``N`` per-rank pipeline states of a
+    checkpoint taken at a different rank count.
+
+    The global sample position ``g`` (post-shuffle samples the whole
+    job consumed this epoch) is the sum over the saved ranks' positions;
+    ``it``'s own ``shard(index, count)`` stage then determines which
+    slice of ``[0, g)`` this rank must have consumed, and the pipeline
+    fast-forwards there — so the union of all new ranks' remaining
+    streams is exactly the samples the interrupted job had not yet
+    consumed, in the same order (sample-exact elastic resume). Raises
+    ``ValueError`` when ``g`` does not sit on a batch boundary of the
+    new topology (resume at a compatible global batch size) or when the
+    chains violate the invariance contract above."""
+    if not states:
+        raise ValueError("no saved pipeline states to reshard from")
+    # old side: per-rank consumed samples + structural fingerprint
+    old_chains = [_state_chain(sd) for sd in states]
+    old_infos = [_chain_info(c, f"saved rank {i}")
+                 for i, c in enumerate(old_chains)]
+    old_kinds = old_infos[0][4]
+    for i, info in enumerate(old_infos[1:], 1):
+        if info[4] != old_kinds:
+            raise ValueError(
+                f"saved rank {i} has a different pipeline structure "
+                f"({info[4]} vs {old_kinds})")
+    for i, (sd, info) in enumerate(zip(states, old_infos)):
+        sh = info[1]
+        if sh is not None and "shard_count" in sh \
+                and int(sh["shard_count"]) != len(states):
+            raise ValueError(
+                f"saved rank {i} records shard_count="
+                f"{sh['shard_count']} but {len(states)} sidecars were "
+                "given — pass every saved rank's state, in rank order")
+    epochs = {_chain_epoch(chain) for chain in old_chains}
+    if len(epochs) != 1:
+        raise ValueError(
+            f"saved ranks disagree on the epoch ({sorted(epochs)}) — "
+            "not a synchronized checkpoint")
+    epoch = epochs.pop()
+    g = sum(int(sd["cursor"]) * info[0]
+            for sd, info in zip(states, old_infos))
+
+    # new side: this rank's slice of [0, g)
+    top, wrap = _unwrap_target(it)
+    new_chain = _live_chain_states(_stage_chain(top))
+    _mult, shard_node, above, below, new_kinds = _chain_info(
+        new_chain, "new pipeline")
+    if new_kinds != old_kinds:
+        raise ValueError(
+            "pipeline structure changed across the topology change "
+            f"(saved {old_kinds}, new {new_kinds}) — only batch size, "
+            "shard fan-out and prefetch may differ")
+    if shard_node is None:
+        index, count = 0, 1
+    else:
+        index = int(shard_node["shard_index"])
+        count = int(shard_node["shard_count"])
+    if g % below:
+        raise ValueError(
+            f"global sample position {g} is not a multiple of the new "
+            f"pipeline's sub-shard batching ({below}) — resume with a "
+            "compatible batch size")
+    items = g // below                    # at the shard's granularity
+    mine = max(0, (items - index + count - 1) // count)
+    if mine % above:
+        raise ValueError(
+            f"rank {index}/{count} would resume at item {mine}, not a "
+            f"multiple of its post-shard batch size {above} — the "
+            "checkpoint does not sit on a global batch boundary of the "
+            "new topology (choose batch sizes so the global batch "
+            "divides evenly)")
+    cursor = mine // above
+    for node in new_chain:
+        node["epoch"] = epoch
+    inner = new_chain[0]
+    inner["cursor"] = cursor
+    _log.info(
+        "resharded input state: %d saved rank(s) -> rank %d/%d, global "
+        "sample position %d (epoch %d) -> local cursor %d",
+        len(states), index, count, g, epoch, cursor)
+    it.load_state_dict(wrap(cursor, inner))
+
+
+def reshard_iterator_states(states: Sequence[Dict[str, Any]],
+                            pipelines: Sequence[Any]) -> None:
+    """Convenience: :func:`reshard_iterator_state` over every new-rank
+    pipeline (single-process simulations of a multi-rank input fleet —
+    ``tools/chaos_soak.py --elastic`` — and tests)."""
+    for pipe in pipelines:
+        reshard_iterator_state(states, pipe)
+
+
+_SIDECAR_RE = re.compile(r"\.data-(\d+)\.json$")
+
+
+def _recorded_shard_count(sd: Dict[str, Any]) -> Optional[int]:
+    """The ``shard_count`` a saved state chain records (None for
+    pre-PR-7 sidecars or chains without a shard stage)."""
+    for node in _state_chain(sd):
+        if node.get("kind") == "shard" and "shard_count" in node:
+            return int(node["shard_count"])
+    return None
+
+
+def _live_shard_count(it) -> Optional[int]:
+    """The shard fan-out of a live pipeline (None when there is no —
+    or more than one — shard stage; the reshard path then applies its
+    own validation)."""
+    from .pipeline import _Shard
+
+    top, _wrap = _unwrap_target(it)
+    shards = [s for s in _stage_chain(top) if isinstance(s, _Shard)]
+    if len(shards) != 1:
+        return None
+    return int(shards[0].shard_count)
+
+
+def restore_sidecars(prefix: str, it) -> None:
+    """Restore ``it`` from the ``{prefix}.data-{rank}.json`` sidecars.
+
+    Same topology — the sidecar's RECORDED shard fan-out matches the
+    live pipeline's and one sidecar per live process is present — the
+    bit-exact PR 5 path loads this rank's file directly. Any topology
+    change (different fan-out recorded, or a sidecar-count/process-count
+    mismatch): load EVERY saved rank's sidecar and re-partition the
+    global sample position via :func:`reshard_iterator_state` — which
+    itself refuses an incomplete sidecar set, so a LOST sidecar can
+    never silently resume a mis-dealt stream."""
+    import jax
+
+    rank = jax.process_index()
+    mine = f"{prefix}.data-{rank}.json"
+    found: Dict[int, str] = {}
+    for path in glob.glob(f"{glob.escape(prefix)}.data-*.json"):
+        m = _SIDECAR_RE.search(path)
+        if m:
+            found[int(m.group(1))] = path
+    if not found:
+        raise FileNotFoundError(mine)
+
+    def _read(path: str) -> Dict[str, Any]:
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("magic") != _MAGIC:
+            raise ValueError(f"not a {_MAGIC} iterator state: {path}")
+        return payload["state"]
+
+    if len(found) == jax.process_count() and rank in found:
+        state = _read(found[rank])
+        recorded = _recorded_shard_count(state)
+        live = _live_shard_count(it)
+        if recorded is None or live is None or recorded == live:
+            # same topology as far as anything records: the file count
+            # matches the live processes and the dealing stride is
+            # unchanged — the bit-exact direct load
+            load_iterator_state(it, {"magic": _MAGIC, "state": state})
+            return
+        # file count happens to match the live world, but the state
+        # was dealt at a DIFFERENT stride (e.g. a saved rank's sidecar
+        # was lost and the job shrank to the surviving count): fall
+        # through to the reshard path, which demands the full set
+    payloads = [_read(found[r]) for r in sorted(found)]
+    _log.warning(
+        "checkpoint input sidecars (%d file(s)) do not match the live "
+        "topology (%d process(es)); re-partitioning the global sample "
+        "position", len(found), jax.process_count())
+    reshard_iterator_state(payloads, it)
+

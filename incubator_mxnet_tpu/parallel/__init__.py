@@ -24,3 +24,5 @@ from .pipeline import (PipelineTrainer, pipeline_apply,
                        stack_stage_params)
 from .checkpoint import (CheckpointError, restore_sharded, save_sharded,
                          validate_sharded)
+from . import reshard
+from .reshard import ReshardEngine

@@ -37,7 +37,7 @@ import logging
 import os
 import re
 import zlib
-from typing import Any, Dict, List, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -137,8 +137,14 @@ def save_sharded(prefix: str, trainer, data_iter=None) -> str:
     _chaos("checkpoint.write", detail=prefix)
     flat = _flatten_state(trainer.params, trainer.opt_state, trainer.frozen)
 
+    from .reshard import mesh_topology
+
     manifest = {"magic": _MAGIC, "tensors": {},
-                "mesh_axes": list(trainer.mesh.axis_names)}
+                "mesh_axes": list(trainer.mesh.axis_names),
+                # the save topology (PR 7): restore cross-checks
+                # shard-rank coverage against it and auto-engages the
+                # reshard planner when the live mesh differs
+                "topology": mesh_topology(trainer.mesh)}
     local = {}
     for name, arr in flat.items():
         arr = jnp.asarray(arr)
@@ -221,68 +227,129 @@ def _load_manifest(prefix: str) -> Dict[str, Any]:
     return manifest
 
 
+class _ShardFileLRU:
+    """At most ``max_open`` shard ``.npz`` files open at once —
+    validating or restoring a many-host checkpoint from one process
+    must not hold every rank's file handle for the whole pass (PR 7
+    satellite). The whole-member ``np.load`` face of the generic
+    ``reshard.LRUHandleCache`` (the slice-level face is
+    ``reshard.ShardReaderCache``)."""
+
+    def __init__(self, prefix: str, max_open: Optional[int] = None):
+        from .reshard import LRUHandleCache
+
+        self.prefix = prefix
+
+        def _open(rank: int):
+            path = f"{prefix}.shards-{rank}.npz"
+            if not os.path.exists(path):
+                raise CheckpointError(f"missing shard file {path}")
+            try:
+                return np.load(path)
+            except Exception as e:  # zipfile.BadZipFile, OSError, ...
+                raise CheckpointError(
+                    f"unreadable shard file {path}: {e}") from e
+
+        self._lru = LRUHandleCache(_open, max_open=max_open)
+
+    def get(self, rank: int):
+        return self._lru.get(rank)
+
+    @property
+    def opens(self) -> int:
+        return self._lru.opens
+
+    @property
+    def open_count(self) -> int:
+        return self._lru.open_count
+
+    def close(self) -> None:
+        self._lru.close()
+
+
 def validate_sharded(prefix: str) -> Dict[str, Any]:
     """Prove a sharded checkpoint whole; return its parsed manifest.
 
-    Checks, in order: manifest present/parseable/right magic; every
-    referenced shard file opens as a zip archive; every referenced shard
-    key present with the extents the manifest records; crc32 of the
-    stored bytes matches where the manifest carries one (pre-PR-6
-    checkpoints don't — they get the structural checks only); every
-    tensor's shards cover its full volume (a merge that lost a rank's
-    listing, or a partially-written multi-host save, fails here).
+    Checks, in order: manifest present/parseable/right magic; shard-rank
+    coverage against the recorded save topology (PR 7 — a missing
+    rank's file or a manifest merge that lost a rank's listing fails
+    HERE, not as a ``KeyError`` mid-rebuild); every referenced shard
+    file opens as a zip archive; every referenced shard key present
+    with the extents the manifest records; crc32 of the stored bytes
+    matches where the manifest carries one (pre-PR-6 checkpoints don't —
+    they get the structural checks only); every tensor's shards cover
+    its full volume (a partially-written multi-host save fails here).
 
     Raises :class:`CheckpointError`; never touches trainer state, so
     callers can probe candidates freely (``resilience.CheckpointManager
     .newest_valid`` walks checkpoints newest-first through this)."""
     manifest = _load_manifest(prefix)
-    files: Dict[int, Any] = {}
     ranks = {sh["rank"] for entry in manifest["tensors"].values()
              for sh in entry["shards"]}
-    for rank in sorted(ranks):
-        path = f"{prefix}.shards-{rank}.npz"
-        if not os.path.exists(path):
-            raise CheckpointError(f"missing shard file {path}")
-        try:
-            files[rank] = np.load(path)
-        except Exception as e:     # zipfile.BadZipFile, OSError, ...
+    topo = manifest.get("topology") or {}
+    saved_pc = int(topo.get("process_count", 0) or 0)
+    if saved_pc:
+        over = sorted(r for r in ranks if r >= saved_pc)
+        if over:
             raise CheckpointError(
-                f"unreadable shard file {path}: {e}") from e
+                f"manifest references shard rank(s) {over} but records "
+                f"a save topology of {saved_pc} process(es): {prefix}")
+        # every saving process wrote a shard file; all must be present
+        # even when a merge lost that rank's tensor listings
+        ranks = ranks | set(range(saved_pc))
+    # group the shard checks RANK-major so each shard file is opened
+    # once and checked in full before moving on — tensor-major order
+    # would thrash the LRU on checkpoints with more ranks than
+    # MXTPU_RESHARD_MAX_OPEN_FILES (a zip directory re-parse per shard)
+    by_rank: Dict[int, List[Tuple[str, Dict[str, Any]]]] = {}
+    covered: Dict[str, int] = {}
     for name, entry in manifest["tensors"].items():
         shape = tuple(entry["shape"])
         volume = int(np.prod(shape)) if shape else 1
-        covered = 0
         if not entry["shards"] and volume:
             raise CheckpointError(
                 f"tensor {name} has no shards in {prefix}")
+        covered[name] = 0
         for sh in entry["shards"]:
-            npz = files[sh["rank"]]
-            if sh["key"] not in getattr(npz, "files", ()):
-                raise CheckpointError(
-                    f"shard {sh['key']} of {name} missing from "
-                    f"{prefix}.shards-{sh['rank']}.npz")
-            try:
-                data = npz[sh["key"]]
-            except Exception as e:  # truncated/corrupt member
-                raise CheckpointError(
-                    f"shard {sh['key']} of {name} unreadable: {e}") from e
-            extents = tuple(b - a for a, b in sh["index"])
-            if tuple(data.shape) != extents:
-                raise CheckpointError(
-                    f"shard {sh['key']} of {name} has shape "
-                    f"{tuple(data.shape)}, manifest says {extents}")
-            if "crc32" in sh:
-                crc = zlib.crc32(np.ascontiguousarray(data).data)
-                if crc != sh["crc32"]:
+            by_rank.setdefault(sh["rank"], []).append((name, sh))
+    files = _ShardFileLRU(prefix)
+    try:
+        for rank in sorted(ranks):
+            npz = files.get(rank)       # presence + zip readability
+            for name, sh in by_rank.get(rank, ()):
+                if sh["key"] not in getattr(npz, "files", ()):
                     raise CheckpointError(
-                        f"shard {sh['key']} of {name} fails its "
-                        f"checksum (stored {sh['crc32']}, read {crc})")
-            covered += int(np.prod(extents)) if extents else 1
-        if covered != volume:
+                        f"shard {sh['key']} of {name} missing from "
+                        f"{prefix}.shards-{rank}.npz")
+                try:
+                    data = npz[sh["key"]]
+                except Exception as e:  # truncated/corrupt member
+                    raise CheckpointError(
+                        f"shard {sh['key']} of {name} unreadable: "
+                        f"{e}") from e
+                extents = tuple(b - a for a, b in sh["index"])
+                if tuple(data.shape) != extents:
+                    raise CheckpointError(
+                        f"shard {sh['key']} of {name} has shape "
+                        f"{tuple(data.shape)}, manifest says {extents}")
+                if "crc32" in sh:
+                    crc = zlib.crc32(np.ascontiguousarray(data).data)
+                    if crc != sh["crc32"]:
+                        raise CheckpointError(
+                            f"shard {sh['key']} of {name} fails its "
+                            f"checksum (stored {sh['crc32']}, read "
+                            f"{crc})")
+                covered[name] += int(np.prod(extents)) if extents else 1
+    finally:
+        files.close()
+    for name, entry in manifest["tensors"].items():
+        shape = tuple(entry["shape"])
+        volume = int(np.prod(shape)) if shape else 1
+        if covered[name] != volume:
             raise CheckpointError(
-                f"tensor {name} covered {covered} of {volume} elements "
-                f"in {prefix} (incomplete manifest merge or partial "
-                "multi-host save)")
+                f"tensor {name} covered {covered[name]} of {volume} "
+                f"elements in {prefix} (incomplete manifest merge "
+                "or partial multi-host save)")
     return manifest
 
 
@@ -318,6 +385,7 @@ def _sibling_fallbacks(prefix: str) -> List[str]:
 def restore_sharded(prefix: str, trainer, data_iter=None, *,
                     validate: bool = True,
                     fallback: Union[str, Sequence[str], None] = "auto",
+                    reshard: Optional[str] = None,
                     ) -> str:
     """Restore params/frozen/opt_state in place, preserving shardings on
     the trainer's current mesh; returns the prefix actually restored.
@@ -331,11 +399,24 @@ def restore_sharded(prefix: str, trainer, data_iter=None, *,
     warning) instead of raising; only when no candidate validates does
     :class:`CheckpointError` surface.
 
+    **Topology portability** (PR 7): when the manifest's recorded save
+    topology differs from the live mesh — fewer/more processes, a
+    different device count or mesh shape — the restore auto-engages the
+    slice-planning :class:`~.reshard.ReshardEngine`: only the byte
+    ranges intersecting each *destination* addressable shard are read
+    from the ``.shards-{rank}.npz`` files, never the full global array,
+    with ``mxtpu_reshard_*`` telemetry. ``reshard`` (or the
+    ``MXTPU_RESHARD_MODE`` knob) forces the choice: ``"auto"``
+    (default), ``"always"``, ``"never"``.
+
     ``data_iter`` (optional): restore the input pipeline's iteration
-    state from this rank's ``{prefix}.data-{rank}.json`` sidecar (see
+    state from the ``{prefix}.data-{rank}.json`` sidecars (see
     :func:`save_sharded`) — applied LAST, after the manifest validates
     and the tensors restore, so a failed/corrupt restore never leaves a
-    live pipeline rewound while the trainer kept its old state."""
+    live pipeline rewound while the trainer kept its old state. When
+    the sidecar rank count differs from the live process count, the
+    global sample position is re-partitioned over the new rank count
+    (``data.state.restore_sidecars``)."""
     if validate:
         try:
             manifest = validate_sharded(prefix)
@@ -360,23 +441,38 @@ def restore_sharded(prefix: str, trainer, data_iter=None, *,
     else:
         manifest = _load_manifest(prefix)
 
-    shard_files: Dict[int, Any] = {}
+    from .reshard import ReshardEngine, topology_mismatch
 
-    def _read(rank: int, key: str) -> np.ndarray:
-        if rank not in shard_files:
-            shard_files[rank] = np.load(f"{prefix}.shards-{rank}.npz")
-        return shard_files[rank][key]
+    if reshard is None:
+        from ..config import config
 
+        reshard = str(config.get("MXTPU_RESHARD_MODE") or "auto").lower()
+    if reshard not in ("auto", "always", "never"):
+        raise ValueError(f"reshard mode {reshard!r} not in "
+                         "('auto', 'always', 'never')")
     mesh = trainer.mesh
+    engine = None
+    if reshard == "always" or (
+            reshard == "auto" and topology_mismatch(manifest, mesh)):
+        engine = ReshardEngine(prefix, manifest, mesh)
+        _log.info("restore of %s engaging the reshard planner "
+                  "(saved topology %s, live mesh %s over %d devices)",
+                  prefix, manifest.get("topology"), dict(mesh.shape),
+                  mesh.devices.size)
 
-    def build(name: str):
+    shard_files = _ShardFileLRU(prefix)
+
+    def build(name: str, current_leaf=None):
+        if engine is not None:
+            return engine.build(name, current_leaf)
+        _chaos("checkpoint.restore", detail=name)
         entry = manifest["tensors"][name]
         shape = tuple(entry["shape"])
         dtype = np.dtype(entry["dtype"])
         full = np.zeros(shape, dtype)
         for sh in entry["shards"]:
             idx = tuple(slice(a, b) for a, b in sh["index"])
-            full[idx] = _read(sh["rank"], sh["key"])
+            full[idx] = shard_files.get(sh["rank"])[sh["key"]]
         sharding = NamedSharding(mesh, _spec_from_json(entry["spec"]))
         return jax.device_put(jnp.asarray(full), sharding)
 
@@ -389,32 +485,40 @@ def restore_sharded(prefix: str, trainer, data_iter=None, *,
                 continue
             key = f"{group}/{prefix}{n}"
             if key in manifest["tensors"]:
-                out[n] = build(key)
+                out[n] = build(key, v)
             elif required:
                 raise KeyError(f"checkpoint missing parameter {prefix}{n}")
             else:
                 out[n] = v
         return out
 
-    new_params = rebuild(trainer.params, "param")
-    new_frozen = rebuild(trainer.frozen, "frozen", required=False)
+    try:
+        new_params = rebuild(trainer.params, "param")
+        new_frozen = rebuild(trainer.frozen, "frozen", required=False)
 
-    leaves, treedef = jax.tree_util.tree_flatten(trainer.opt_state)
-    new_leaves = []
-    i = 0
-    for leaf in leaves:
-        if hasattr(leaf, "shape") and f"opt/{i}" in manifest["tensors"]:
-            new_leaves.append(build(f"opt/{i}"))
-        else:
-            new_leaves.append(leaf)
-        i += 1
+        leaves, treedef = jax.tree_util.tree_flatten(trainer.opt_state)
+        new_leaves = []
+        i = 0
+        for leaf in leaves:
+            if hasattr(leaf, "shape") and f"opt/{i}" in manifest["tensors"]:
+                new_leaves.append(build(f"opt/{i}", leaf))
+            else:
+                new_leaves.append(leaf)
+            i += 1
+    except BaseException:
+        if engine is not None:
+            engine.abort()
+        raise
+    finally:
+        shard_files.close()
     trainer.params = new_params
     trainer.frozen = new_frozen
     trainer.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    if engine is not None:
+        engine.finish()
 
     if data_iter is not None:
-        from ..data.state import load_iterator_state_file
+        from ..data.state import restore_sidecars
 
-        load_iterator_state_file(
-            f"{prefix}.data-{jax.process_index()}.json", data_iter)
+        restore_sidecars(prefix, data_iter)
     return prefix

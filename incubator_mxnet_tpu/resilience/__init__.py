@@ -17,6 +17,11 @@ tolerance as a first-class subsystem. Three cooperating layers:
 * :mod:`chaos` — deterministic, seeded fault injection at registered
   sites, so every recovery path above is exercised by ordinary
   deterministic tests and ``tools/chaos_soak.py``.
+* :class:`ElasticRunner` (:mod:`elastic`, PR 7) — when the fault IS the
+  topology (a dead host, a shrunken pod): rebuild the trainer on
+  whatever hardware survives and reshard-restore the newest checkpoint
+  onto it (``parallel/reshard.py`` slice planner + N->M data-sidecar
+  re-partitioning), continuing the same loss stream.
 
 Quick start::
 
@@ -32,11 +37,12 @@ Quick start::
 from . import chaos
 from .chaos import ChaosPlan, InjectedFault
 from .checkpoint_manager import CheckpointManager
+from .elastic import ElasticRunner
 from .supervisor import (FatalError, HungStepError, Preempted, Supervisor,
                          TransientError, default_classify)
 
 __all__ = [
-    "ChaosPlan", "CheckpointManager", "FatalError", "HungStepError",
-    "InjectedFault", "Preempted", "Supervisor", "TransientError",
-    "chaos", "default_classify",
+    "ChaosPlan", "CheckpointManager", "ElasticRunner", "FatalError",
+    "HungStepError", "InjectedFault", "Preempted", "Supervisor",
+    "TransientError", "chaos", "default_classify",
 ]

@@ -28,6 +28,10 @@ site                   fires at
 ``checkpoint.commit``  after the shard files, before the manifest — the
                        torn-write window; with ``action='exit'`` this is
                        the SIGKILL-mid-save scenario
+``checkpoint.restore`` inside ``parallel.restore_sharded``'s per-tensor
+                       rebuild, after validation — a restore (or
+                       elastic reshard-restore) dying mid-way; the
+                       trainer's live state is still untouched
 ``data.worker``        inside a data-pipeline producer/worker thread,
                        before it pulls the next item — the fault
                        propagates to the consumer's ``next()`` without
@@ -75,6 +79,9 @@ SITES: Dict[str, str] = {
     "checkpoint.write": "save_sharded before shard files are written",
     "checkpoint.commit": "save_sharded torn-write window (shards on "
                          "disk, manifest not yet)",
+    "checkpoint.restore": "restore_sharded per-tensor rebuild (after "
+                          "validation, before/mid reshard) — a restore "
+                          "interrupted on whatever hardware is left",
     "data.worker": "data-pipeline producer thread, before the next item",
 }
 

@@ -1,0 +1,167 @@
+"""Elastic restart: resume *some* job on whatever hardware is alive.
+
+PR 6's :class:`~.supervisor.Supervisor` restarts a failed run onto the
+SAME trainer — fine for transient faults, useless when the fault *is*
+the topology (a host died, the pod shrank, the job was rescheduled onto
+fewer chips). The missing layer is rebuild-and-reshard:
+
+1. a fatal failure escalates past the in-place Supervisor restarts;
+2. the caller-supplied ``build_fn`` constructs a **fresh trainer and
+   feed on the surviving mesh** (a smaller device set, a different
+   process count — whatever is actually alive);
+3. ``CheckpointManager.restore_latest`` restores the newest valid
+   checkpoint into it — ``parallel.restore_sharded`` detects the
+   topology change and engages the slice-planning reshard engine
+   (``parallel/reshard.py``), and the data sidecars re-partition the
+   global sample position over the new rank count
+   (``data.state.restore_sidecars``);
+4. the supervised loop continues from the restored step.
+
+Because every rewound ingredient stays bit-exact (tensors restore
+bit-identically under resharding; the input stream is re-dealt from the
+same global sample position; RNG state rides ``meta.json``), the merged
+loss stream across incarnations equals the uninterrupted run's —
+``tools/chaos_soak.py --elastic`` asserts exactly this, shrinking both
+the mesh and the simulated input rank count mid-run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .checkpoint_manager import CheckpointManager
+from .supervisor import Preempted, Supervisor
+
+_log = logging.getLogger("mxtpu.resilience")
+
+__all__ = ["ElasticRunner"]
+
+
+def _cfg(name: str):
+    from ..config import config
+
+    return config.get(name)
+
+
+class ElasticRunner:
+    """Run a training job to completion across trainer incarnations.
+
+    ``build_fn(incarnation) -> (trainer, feed)`` constructs the job for
+    incarnation ``i`` (0 = the initial topology; ``i >= 1`` after a
+    fatal loss — build on whatever mesh/rank count survives). ``root``
+    is the shared checkpoint directory; each incarnation gets a fresh
+    :class:`CheckpointManager` over it and resumes from the newest
+    valid checkpoint automatically (resharding when the topology
+    changed).
+
+    ``supervisor_kwargs`` are forwarded to each incarnation's
+    :class:`Supervisor` (checkpoint cadence, retry budgets, ...).
+
+    Usage::
+
+        def build(incarnation):
+            mesh = parallel.make_mesh({"data": -1},
+                                      devices=alive_devices())
+            trainer = parallel.SPMDTrainer(make_net(), loss, "sgd",
+                                           opts, mesh=mesh)
+            return trainer, make_feed(jax.process_index(),
+                                      jax.process_count())
+
+        runner = resilience.ElasticRunner(build, "ckpts/",
+                                          checkpoint_every=50)
+        losses = runner.run(steps=10_000)
+    """
+
+    def __init__(self, build_fn: Callable[[int], Tuple[Any, Any]],
+                 root: str, *, max_incarnations: Optional[int] = None,
+                 manager_kwargs: Optional[Dict[str, Any]] = None,
+                 **supervisor_kwargs):
+        self.build_fn = build_fn
+        self.root = root
+        self.max_incarnations = int(
+            _cfg("MXTPU_ELASTIC_MAX_INCARNATIONS")
+            if max_incarnations is None else max_incarnations)
+        self.manager_kwargs = dict(manager_kwargs or {})
+        self.supervisor_kwargs = dict(supervisor_kwargs)
+        self.incarnation = 0
+        self.supervisor: Optional[Supervisor] = None
+        self.manager: Optional[CheckpointManager] = None
+        from .. import telemetry
+
+        self._t_incarnations = telemetry.counter(
+            "mxtpu_resilience_incarnations_total",
+            "elastic trainer rebuilds after a fatal incarnation loss")
+
+    def run(self, steps: int) -> List[float]:
+        """Supervised steps ``0..steps`` across as many incarnations as
+        it takes (at most ``max_incarnations`` rebuilds). Returns the
+        loss per global step; steps executed by an earlier incarnation
+        and not re-run after its restore point keep that incarnation's
+        (bit-exact) values."""
+        merged: Dict[int, float] = {}
+        incarnation = self.incarnation
+        while True:
+            trainer, feed = self.build_fn(incarnation)
+            self.manager = CheckpointManager(self.root,
+                                             **self.manager_kwargs)
+            self.supervisor = Supervisor(trainer, self.manager,
+                                         **self.supervisor_kwargs)
+            self.incarnation = incarnation
+            try:
+                out = self.supervisor.run(feed, steps=steps)
+            except (KeyboardInterrupt, Preempted):
+                raise
+            except BaseException as exc:    # noqa: BLE001 — policy layer
+                # keep what this incarnation proved before dying, then
+                # rebuild on whatever the next build_fn says is alive
+                merged.update(self.supervisor.losses)
+                self._close(feed)
+                try:
+                    # settle in-flight async saves: two managers' writer
+                    # threads must never overlap on one root (the tmp
+                    # reaper is only safe within one manager)
+                    self.manager.wait(timeout=60.0)
+                except Exception:
+                    pass
+                incarnation += 1
+                if incarnation > self.max_incarnations:
+                    _log.error(
+                        "elastic incarnation budget exhausted (%d); "
+                        "giving up", self.max_incarnations)
+                    raise
+                self._t_incarnations.inc()
+                self._emit({"event": "elastic_rebuild",
+                            "incarnation": incarnation,
+                            "error": str(exc)[:200]})
+                _log.warning(
+                    "incarnation %d lost (%s: %s); rebuilding as "
+                    "incarnation %d on the surviving topology",
+                    incarnation - 1, type(exc).__name__, exc,
+                    incarnation)
+                continue
+            merged.update(self.supervisor.losses)
+            # the runner built the feed (via build_fn), so the runner
+            # closes it — on success as much as on failure; a caller
+            # that needs the feed afterwards can capture it in its
+            # build_fn closure
+            self._close(feed)
+            self._emit({"event": "elastic_complete",
+                        "incarnation": incarnation, "steps": int(steps),
+                        "rebuilds": incarnation})
+            return [float(merged.get(i, float("nan")))
+                    for i in range(int(steps))]
+
+    @staticmethod
+    def _close(feed) -> None:
+        close = getattr(feed, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        from .. import telemetry
+
+        telemetry.jsonl_emit({"kind": "resilience", **record})

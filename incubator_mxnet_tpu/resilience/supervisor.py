@@ -162,6 +162,7 @@ class Supervisor:
         self.retries = 0
         self.restarts = 0
         self.hung_steps = 0
+        self.losses: Dict[int, float] = {}
         self._ema_s: Optional[float] = None  # fallback when no StepMeter
         self._preempt = threading.Event()
         self._prev_handlers: Dict[int, Any] = {}
@@ -228,7 +229,9 @@ class Supervisor:
                 if restored is not None:
                     start_step = restored
         self.step_num = int(start_step)
-        losses: Dict[int, float] = {}
+        # public ledger: resilience.elastic merges the losses of a dead
+        # incarnation (run() never returned) into the next one's stream
+        self.losses = losses = {}    # type: Dict[int, float]
         feed_iter = iter(feed)
         while self.step_num < steps:
             if self._preempt.is_set():

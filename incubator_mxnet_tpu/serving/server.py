@@ -97,14 +97,32 @@ class ModelServer:
     def from_checkpoint(cls, block, params_path: str, ctx=None,
                         use_native: Optional[bool] = None,
                         **kwargs) -> "ModelServer":
-        """Load ``params_path`` into ``block`` and serve it. Reads through
-        the native C ABI (``mxio_params_*``) when the library is
+        """Load ``params_path`` into ``block`` and serve it.
+
+        ``params_path`` may be a native ``.params`` checkpoint (read
+        through the C ABI ``mxio_params_*`` when the library is
         available — the same reader non-Python consumers use — else
-        falls back to ``nd.load``. ``use_native=True`` makes a missing
-        native library an error instead of a silent fallback."""
+        ``nd.load``; ``use_native=True`` makes a missing native library
+        an error instead of a silent fallback) **or a sharded training
+        checkpoint prefix/manifest** written by ``parallel.save_sharded``
+        on any mesh: the ``param/`` + ``frozen/`` tensors are assembled
+        at M=1 through the slice-planning reshard reader
+        (``parallel/reshard.py``) — a multi-chip training checkpoint
+        feeds the 1-chip serving tier directly, no export step,
+        optimizer state never touched (docs/SERVING.md
+        "Serving a training checkpoint")."""
         from .. import native
         from ..ndarray import ndarray as _ndimpl
 
+        sharded_prefix = cls._sharded_prefix(params_path)
+        if sharded_prefix is not None:
+            from ..parallel.reshard import load_dense_arrays
+
+            arrays = load_dense_arrays(sharded_prefix)
+            loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
+                      for k, v in arrays.items()}
+            block._load_parameters_dict(loaded, params_path, ctx=ctx)
+            return cls(block, **kwargs)
         if use_native is None:
             use_native = native.lib() is not None
         if use_native:
@@ -115,6 +133,18 @@ class ModelServer:
         else:
             block.load_parameters(params_path, ctx=ctx)
         return cls(block, **kwargs)
+
+    @staticmethod
+    def _sharded_prefix(params_path: str) -> Optional[str]:
+        """The sharded-checkpoint prefix when ``params_path`` names one
+        (the ``{prefix}.manifest.json`` itself or the bare prefix),
+        else None."""
+        suffix = ".manifest.json"
+        if params_path.endswith(suffix) and os.path.exists(params_path):
+            return params_path[:-len(suffix)]
+        if os.path.exists(params_path + suffix):
+            return params_path
+        return None
 
     @classmethod
     def from_exported(cls, path: str, ctx=None, **kwargs) -> "ModelServer":

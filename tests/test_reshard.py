@@ -1,0 +1,596 @@
+"""Topology-portable resharding (PR 7, docs/RESILIENCE.md "Elastic
+restart"): a checkpoint saved on an N-shard mesh restores onto any
+other mesh bit-identically with bounded host memory, the data sidecars
+re-partition the global sample position across rank-count changes, and
+the elastic runner survives losing an incarnation."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu import data as mxdata
+from incubator_mxnet_tpu.data import state as dstate
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import reshard as reshard_mod
+from incubator_mxnet_tpu.parallel.checkpoint import CheckpointError
+
+import jax
+
+
+MESH_SHAPES = {
+    "1": {"data": 1},
+    "2": {"data": 2},
+    "4": {"data": 4},
+    "2x2": {"data": 2, "model": 2},
+}
+
+
+def _mesh(key):
+    axes = MESH_SHAPES[key]
+    n = int(np.prod(list(axes.values())))
+    return parallel.make_mesh(dict(axes), devices=jax.devices()[:n])
+
+
+def _trainer(mesh, seed=0, zero=False):
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    if "model" in mesh.axis_names:
+        parallel.shard_params(net, {
+            r"0\.weight": P("model", None),
+            r"2\.weight": P(None, "model"),
+        })
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+        donate=False, shard_weight_update=zero)
+    return net, tr
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(16, 8).astype(np.float32),
+            rng.randint(0, 4, (16,)).astype(np.float32))
+
+
+def _assert_state_equal(src, dst):
+    for n in src.params:
+        np.testing.assert_array_equal(np.asarray(src.params[n]),
+                                      np.asarray(dst.params[n]), n)
+    for n in src.frozen:
+        np.testing.assert_array_equal(np.asarray(src.frozen[n]),
+                                      np.asarray(dst.frozen[n]), n)
+    src_l = jax.tree_util.tree_leaves(src.opt_state)
+    dst_l = jax.tree_util.tree_leaves(dst.opt_state)
+    for a, b in zip(src_l, dst_l):
+        if hasattr(a, "shape"):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """One stepped + saved source trainer per save-mesh shape."""
+    root = tmp_path_factory.mktemp("reshard")
+    out = {}
+    x, y = _batch(0)
+    for key in MESH_SHAPES:
+        net, tr = _trainer(_mesh(key), seed=int(key[0]))
+        tr.step(x, y)                      # momentum + BN stats nonzero
+        prefix = str(root / f"ckpt-{key}" / "ckpt")
+        os.makedirs(os.path.dirname(prefix))
+        parallel.save_sharded(prefix, tr)
+        out[key] = (prefix, tr, net)
+    return out
+
+
+@pytest.mark.parametrize("src_key", list(MESH_SHAPES))
+@pytest.mark.parametrize("dst_key", list(MESH_SHAPES))
+def test_reshard_matrix_bit_identical(saved, src_key, dst_key):
+    """Save on any of {(1,), (2,), (4,), (2,2)}, restore on any other:
+    every param / BN stat / optimizer leaf is bit-identical and carries
+    the DESTINATION trainer's sharding."""
+    prefix, src, _ = saved[src_key]
+    _, dst = _trainer(_mesh(dst_key), seed=77)
+    parallel.restore_sharded(prefix, dst)
+    _assert_state_equal(src, dst)
+    for n in dst.params:
+        assert dst.params[n].sharding.mesh == dst.mesh
+
+
+def test_manifest_records_save_topology(saved):
+    prefix, _, _ = saved["2x2"]
+    with open(prefix + ".manifest.json") as f:
+        manifest = json.load(f)
+    topo = manifest["topology"]
+    assert topo["process_count"] == 1
+    assert topo["device_count"] == 4
+    assert topo["mesh_shape"] == {"data": 2, "model": 2}
+
+
+def test_reshard_peak_host_bounded_for_sharded_tensor(saved):
+    """Acceptance: peak host memory is bounded by the slice plan — for
+    a TP-sharded tensor restored sharded, the engine's host buffer is
+    strictly smaller than the full array; bytes/ops are accounted."""
+    prefix, src, _ = saved["2x2"]
+    _, dst = _trainer(_mesh("2x2"), seed=5)
+    parallel.restore_sharded(prefix, dst, reshard="always")
+    _assert_state_equal(src, dst)
+    stats = reshard_mod.last_stats()
+    name = next(n for n in stats["tensors"] if n.endswith("0.weight"))
+    t = stats["tensors"][name]
+    assert t["unique_boxes"] > 1           # actually sharded at dest
+    assert t["peak_host_bytes"] < t["full_bytes"]
+    assert t["peak_host_bytes"] == t["full_bytes"] // 2  # model axis = 2
+    assert stats["plan_ops"] > 0 and stats["bytes_read"] > 0
+    assert stats["wall_s"] >= 0
+
+
+def test_reshard_zero1_opt_state_restores_sharded(saved):
+    """A ZeRO-1 destination gets its optimizer state back sharded ITS
+    way (P('data') over the new mesh), values bit-identical."""
+    prefix, src, _ = saved["2"]
+    _, dst = _trainer(_mesh("4"), seed=9, zero=True)
+    parallel.restore_sharded(prefix, dst, reshard="always")
+    _assert_state_equal(src, dst)
+    sharded = [l for l in jax.tree_util.tree_leaves(dst.opt_state)
+               if hasattr(l, "sharding")
+               and str(l.sharding.spec) == str(P("data"))]
+    assert sharded, "no ZeRO-sharded optimizer leaves after restore"
+
+
+def test_step_parity_after_cross_mesh_restore(saved):
+    """Training continues correctly after a planner restore: a trainer
+    restored through the reshard engine and one restored through the
+    legacy gather produce bit-identical next steps (the shared source
+    trainer is left untouched — other tests compare against it)."""
+    prefix, _, _ = saved["2"]
+    _, via_plan = _trainer(_mesh("2"), seed=31)
+    parallel.restore_sharded(prefix, via_plan, reshard="always")
+    _, via_gather = _trainer(_mesh("2"), seed=32)
+    parallel.restore_sharded(prefix, via_gather, reshard="never")
+    x, y = _batch(3)
+    mx.random.seed(11)
+    l_plan = float(via_plan.step(x, y))
+    mx.random.seed(11)
+    l_gather = float(via_gather.step(x, y))
+    assert l_plan == l_gather
+    _assert_state_equal(via_plan, via_gather)
+
+
+def test_reshard_mode_never_keeps_legacy_path(saved):
+    prefix, src, _ = saved["2"]
+    before = reshard_mod.last_stats()
+    _, dst = _trainer(_mesh("4"), seed=13)
+    parallel.restore_sharded(prefix, dst, reshard="never")
+    _assert_state_equal(src, dst)
+    assert reshard_mod.last_stats() is before   # engine never engaged
+
+
+# ---------------------------------------------------------------------------
+# slice reader + file-handle bounds
+# ---------------------------------------------------------------------------
+def test_npz_slice_reader_matches_numpy(tmp_path):
+    rng = np.random.RandomState(0)
+    a = rng.rand(12, 6, 4).astype(np.float32)
+    b = rng.rand(7).astype(np.float32)
+    c = np.float32(1.5).reshape(())
+    path = str(tmp_path / "t.npz")
+    np.savez(path, a=a, b=b, c=c)
+    r = reshard_mod.NpzSliceReader(path)
+    try:
+        box = ((2, 9), (1, 5), (0, 4))
+        np.testing.assert_array_equal(r.read_box("a", box),
+                                      a[2:9, 1:5, 0:4])
+        full_bytes = a.nbytes
+        assert 0 < r.bytes_read < full_bytes   # only the ranges
+        np.testing.assert_array_equal(r.read_box("b", ((3, 6),)),
+                                      b[3:6])
+        np.testing.assert_array_equal(r.read_box("c", ()), c)
+        # inner partial slice too (multiple runs)
+        np.testing.assert_array_equal(
+            r.read_box("a", ((0, 12), (2, 3), (1, 3))),
+            a[:, 2:3, 1:3])
+    finally:
+        r.close()
+
+
+def test_shard_reader_cache_bounds_open_files(tmp_path):
+    prefix = str(tmp_path / "many")
+    for rank in range(6):
+        np.savez(f"{prefix}.shards-{rank}.npz",
+                 **{f"t::0@{rank}": np.full((4,), rank, np.float32)})
+    cache = reshard_mod.ShardReaderCache(prefix, max_open=2)
+    try:
+        for rank in range(6):
+            got = cache.read_box(rank, f"t::0@{rank}", ((0, 4),))
+            np.testing.assert_array_equal(
+                got, np.full((4,), rank, np.float32))
+            assert cache.open_count <= 2
+        # revisit an evicted rank: reopened, still bounded
+        cache.read_box(0, "t::0@0", ((1, 3),))
+        assert cache.open_count <= 2
+        assert cache.opens == 7                # 6 + 1 reopen
+    finally:
+        cache.close()
+    assert cache.open_count == 0
+
+
+def test_many_rank_checkpoint_assembles_densely(tmp_path):
+    """A hand-laid 4-process checkpoint (each rank owns 2 rows of an
+    (8, 3) tensor) validates and assembles correctly through the
+    slice-reading path — the M=1 ingestion of a pod checkpoint."""
+    import zlib
+
+    prefix = str(tmp_path / "pod" / "ckpt")
+    os.makedirs(os.path.dirname(prefix))
+    full = np.arange(24, dtype=np.float32).reshape(8, 3)
+    shards = []
+    for rank in range(4):
+        piece = full[2 * rank:2 * rank + 2]
+        key = f"param/w::0@{rank}"
+        np.savez(f"{prefix}.shards-{rank}.npz", **{key: piece})
+        shards.append({
+            "rank": rank, "key": key,
+            "index": [[2 * rank, 2 * rank + 2], [0, 3]],
+            "crc32": zlib.crc32(np.ascontiguousarray(piece).data),
+        })
+    manifest = {
+        "magic": "MXTPU-SHARD-1", "mesh_axes": ["data"],
+        "topology": {"process_count": 4, "device_count": 4,
+                     "devices_per_process": 1,
+                     "mesh_shape": {"data": 4}},
+        "tensors": {"param/w": {"shape": [8, 3], "dtype": "float32",
+                                "spec": ["data", None],
+                                "shards": shards}},
+    }
+    with open(prefix + ".manifest.json", "w") as f:
+        json.dump(manifest, f)
+    parallel.validate_sharded(prefix)
+    arrays = reshard_mod.load_dense_arrays(prefix)
+    np.testing.assert_array_equal(arrays["w"], full)
+
+
+def test_validate_cross_checks_rank_coverage_upfront(tmp_path, saved):
+    """A checkpoint whose topology says N processes but is missing a
+    rank's shard file (or whose manifest references an impossible rank)
+    fails validation BEFORE any rebuild — not as a KeyError mid-way."""
+    src_prefix, _, _ = saved["2"]
+    prefix = str(tmp_path / "broken" / "ckpt")
+    os.makedirs(os.path.dirname(prefix))
+    for name in os.listdir(os.path.dirname(src_prefix)):
+        shutil.copy(os.path.join(os.path.dirname(src_prefix), name),
+                    os.path.join(os.path.dirname(prefix), name))
+    with open(prefix + ".manifest.json") as f:
+        manifest = json.load(f)
+    # claim two saving processes: rank 1's file is now provably missing
+    manifest["topology"]["process_count"] = 2
+    with open(prefix + ".manifest.json", "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointError, match="shards-1"):
+        parallel.validate_sharded(prefix)
+    # an out-of-range rank in a shard listing is caught too
+    manifest["topology"]["process_count"] = 1
+    next(iter(manifest["tensors"].values()))["shards"][0]["rank"] = 5
+    with open(prefix + ".manifest.json", "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointError, match="rank"):
+        parallel.validate_sharded(prefix)
+
+
+# ---------------------------------------------------------------------------
+# serving ingestion
+# ---------------------------------------------------------------------------
+def test_serving_from_multichip_training_checkpoint(saved):
+    """ModelServer.from_checkpoint serves a (2,2)-mesh TP training
+    checkpoint at M=1: outputs match the source net's eager forward."""
+    from incubator_mxnet_tpu import serving
+
+    prefix, src, src_net = saved["2x2"]
+    src.sync_to_net()
+    x = np.random.RandomState(3).rand(8).astype(np.float32)
+    want = src_net(mx.nd.array(x.reshape(1, -1))).asnumpy()[0]
+
+    net2, _ = _build_serving_block()
+    with serving.ModelServer.from_checkpoint(
+            net2, prefix, max_wait_ms=1.0) as srv:
+        got = np.asarray(srv.predict(x, timeout=30.0))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _build_serving_block():
+    np.random.seed(123)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    return net, None
+
+
+# ---------------------------------------------------------------------------
+# data sidecar resharding
+# ---------------------------------------------------------------------------
+def _rank_pipes(n_ranks, per_rank_batch, seed=5):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(128, 4).astype(np.float32)
+    y = rs.randint(0, 4, (128,)).astype(np.float32)
+    return [(mxdata.from_ndarray(x, y)
+             .shuffle(32, seed=seed)
+             .batch(per_rank_batch)
+             .shard(r, n_ranks))
+            for r in range(n_ranks)]
+
+
+def _global_stream(pipes, steps):
+    """``steps`` global batches: per-rank batches concatenated in rank
+    order (shard above batch => natural contiguous order)."""
+    its = [iter(p) for p in pipes]
+    out = []
+    for _ in range(steps):
+        parts = [next(it) for it in its]
+        out.append(tuple(np.concatenate([p[i] for p in parts])
+                         for i in range(2)))
+    return out
+
+
+@pytest.mark.parametrize("new_ranks,new_batch", [(1, 16), (4, 4)])
+def test_sidecar_reshard_is_sample_exact(new_ranks, new_batch):
+    """Consume 3 global batches on 2 simulated ranks, reshard the
+    states onto {1, 4} ranks: the remaining global stream is
+    bit-identical to the uninterrupted one — no sample lost, repeated,
+    or reordered across the rank-count change."""
+    old = _rank_pipes(2, 8)
+    _global_stream(old, 3)                  # 48 samples consumed
+    states = [p.state_dict() for p in old]
+    for p in old:
+        p.close()
+
+    new = _rank_pipes(new_ranks, new_batch)
+    dstate.reshard_iterator_states(states, new)
+    got = _global_stream(new, 5)            # 5 more global batches
+    for p in new:
+        p.close()
+
+    ref = _rank_pipes(1, 16)
+    want = _global_stream(ref, 8)[3:]       # uninterrupted, same seed
+    for p in ref:
+        p.close()
+    assert len(got) == len(want)
+    for (gx, gy), (wx, wy) in zip(got, want):
+        np.testing.assert_array_equal(gx, wx)
+        np.testing.assert_array_equal(gy, wy)
+
+
+def test_restore_sidecars_repartitions_on_rank_change(tmp_path):
+    """The restore_sharded sidecar hook: N saved sidecar files != live
+    process count => the global position re-partitions (here 2 files
+    -> 1 live process)."""
+    prefix = str(tmp_path / "ck")
+    old = _rank_pipes(2, 8)
+    _global_stream(old, 4)
+    for r, p in enumerate(old):
+        dstate.save_iterator_state_file(f"{prefix}.data-{r}.json", p)
+        p.close()
+    new = _rank_pipes(1, 16)[0]
+    dstate.restore_sidecars(prefix, new)
+    ref = _rank_pipes(1, 16)[0]
+    want = _global_stream([ref], 8)[4:]
+    got = _global_stream([new], 4)
+    for (gx, _gy), (wx, _wy) in zip(got, want):
+        np.testing.assert_array_equal(gx, wx)
+    new.close()
+    ref.close()
+
+
+def test_sidecar_reshard_rejects_misaligned_position(tmp_path):
+    """A global position that does not sit on the new topology's batch
+    boundary is an error, not silent sample loss."""
+    old = _rank_pipes(2, 8)
+    _global_stream(old, 3)                  # g = 48
+    states = [p.state_dict() for p in old]
+    for p in old:
+        p.close()
+    new = _rank_pipes(1, 5)[0]              # 48 not a multiple of 5
+    with pytest.raises(ValueError, match="batch"):
+        dstate.reshard_iterator_state(states, new)
+    new.close()
+
+
+def test_sidecar_reshard_onto_shardless_chain():
+    """Scaling down to one rank naturally drops the shard stage; a
+    shard-less shuffle+batch chain is a valid reshard target (the
+    shuffle-downstream-of-shard guard must not fire without a shard)."""
+    old = _rank_pipes(2, 8)
+    _global_stream(old, 3)
+    states = [p.state_dict() for p in old]
+    for p in old:
+        p.close()
+    rs = np.random.RandomState(5)
+    x = rs.rand(128, 4).astype(np.float32)
+    y = rs.randint(0, 4, (128,)).astype(np.float32)
+    new = (mxdata.from_ndarray(x, y)
+           .shuffle(32, seed=5)
+           .batch(16))                      # no .shard at all
+    dstate.reshard_iterator_state(states, new)
+    got = _global_stream([new], 5)
+    new.close()
+    ref = _rank_pipes(1, 16)
+    want = _global_stream(ref, 8)[3:]
+    for p in ref:
+        p.close()
+    for (gx, _), (wx, _) in zip(got, want):
+        np.testing.assert_array_equal(gx, wx)
+
+
+def test_restore_sidecars_refuses_lost_sidecar_mis_deal(tmp_path):
+    """A checkpoint saved on 3 ranks with rank 2's sidecar LOST, resumed
+    on... however many files happen to remain: the recorded shard_count
+    (3) disagrees with the live pipeline's fan-out, so the direct-load
+    fast path must NOT engage — and the reshard path refuses the
+    incomplete sidecar set instead of silently mis-dealing samples."""
+    prefix = str(tmp_path / "ck")
+    old = _rank_pipes(3, 8)
+    # consume 2 global batches' worth on each saved rank
+    for p in old:
+        it = iter(p)
+        next(it), next(it)
+    for r, p in enumerate(old):
+        dstate.save_iterator_state_file(f"{prefix}.data-{r}.json", p)
+        p.close()
+    os.remove(f"{prefix}.data-2.json")     # the dead host's sidecar
+    # pretend this is a 2-process world now: 2 files == 2 processes,
+    # but each surviving pipeline deals at stride 2, not the saved 3
+    new = _rank_pipes(2, 8)[0]
+    with pytest.raises(ValueError, match="every saved rank"):
+        dstate.restore_sidecars(prefix, new)
+    new.close()
+
+
+def test_validate_opens_each_shard_file_once(tmp_path):
+    """Rank-major validation: a checkpoint with more ranks than the
+    open-file bound still opens each shard file exactly once."""
+    import zlib
+
+    from incubator_mxnet_tpu.config import config
+    from incubator_mxnet_tpu.parallel import checkpoint as ckpt_mod
+
+    prefix = str(tmp_path / "wide" / "ckpt")
+    os.makedirs(os.path.dirname(prefix))
+    full = np.arange(48, dtype=np.float32).reshape(6, 8)
+    shards = []
+    for rank in range(6):
+        piece = full[rank:rank + 1]
+        key = f"param/w::0@{rank}"
+        np.savez(f"{prefix}.shards-{rank}.npz", **{key: piece})
+        shards.append({"rank": rank, "key": key,
+                       "index": [[rank, rank + 1], [0, 8]],
+                       "crc32": zlib.crc32(
+                           np.ascontiguousarray(piece).data)})
+    manifest = {
+        "magic": "MXTPU-SHARD-1", "mesh_axes": ["data"],
+        "topology": {"process_count": 6, "device_count": 6,
+                     "devices_per_process": 1,
+                     "mesh_shape": {"data": 6}},
+        "tensors": {"param/w": {"shape": [6, 8], "dtype": "float32",
+                                "spec": ["data", None],
+                                "shards": shards}},
+    }
+    with open(prefix + ".manifest.json", "w") as f:
+        json.dump(manifest, f)
+    opens = []
+    orig = ckpt_mod._ShardFileLRU
+
+    class Spy(orig):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            opens.append(self)
+
+    config.set("MXTPU_RESHARD_MAX_OPEN_FILES", 2)
+    ckpt_mod._ShardFileLRU = Spy
+    try:
+        parallel.validate_sharded(prefix)
+    finally:
+        ckpt_mod._ShardFileLRU = orig
+        config.unset("MXTPU_RESHARD_MAX_OPEN_FILES")
+    assert opens and opens[-1].opens == 6   # one np.load per rank file
+
+
+def test_sidecar_reshard_rejects_legacy_states():
+    """Pre-PR-7 sidecars (no batch_size in the batch stage state) are
+    refused with a pointed message, not mis-resharded."""
+    old = _rank_pipes(2, 8)
+    _global_stream(old, 2)
+    states = [p.state_dict() for p in old]
+    for p in old:
+        p.close()
+    for sd in states:
+        node = sd
+        while node is not None:
+            node.pop("batch_size", None)
+            node = node.get("source")
+    new = _rank_pipes(1, 16)[0]
+    with pytest.raises(ValueError, match="batch_size"):
+        dstate.reshard_iterator_state(states, new)
+    new.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos + elastic restart
+# ---------------------------------------------------------------------------
+def test_chaos_restore_site_leaves_trainer_untouched(saved):
+    from incubator_mxnet_tpu import resilience
+
+    prefix, src, _ = saved["2"]
+    _, dst = _trainer(_mesh("2"), seed=55)
+    before = {n: np.asarray(dst.params[n]).copy() for n in dst.params}
+    resilience.chaos.configure(
+        {"checkpoint.restore": {"at_calls": [1]}}, seed=0)
+    try:
+        with pytest.raises(resilience.InjectedFault):
+            parallel.restore_sharded(prefix, dst, reshard="always")
+        # the fault fired before any live state was assigned
+        for n in before:
+            np.testing.assert_array_equal(np.asarray(dst.params[n]),
+                                          before[n])
+        # second attempt passes (at_calls=[1] spent) — retryable restore
+        parallel.restore_sharded(prefix, dst, reshard="always")
+    finally:
+        resilience.chaos.disable()
+    _assert_state_equal(src, dst)
+
+
+def test_elastic_runner_rebuilds_and_completes(tmp_path):
+    """A fatal fault kills incarnation 0 past its first checkpoint; the
+    ElasticRunner rebuilds (same 1-device mesh — cross-mesh numerics
+    are covered by the soak) and the merged loss stream equals the
+    uninterrupted run bit-exactly."""
+    from incubator_mxnet_tpu import resilience
+
+    def build(_incarnation=0):
+        mx.random.seed(21)
+        np.random.seed(21)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=16))
+        net.initialize(init="xavier")
+        tr = parallel.SPMDTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, mesh=_mesh("1"))
+        rs = np.random.RandomState(2)
+        pipe = (mxdata.from_ndarray(
+                    rs.rand(96, 8).astype(np.float32),
+                    rs.randint(0, 4, (96,)).astype(np.float32))
+                .shuffle(16, seed=3).batch(8).shard(0, 1))
+        return tr, pipe
+
+    tr, pipe = build()
+    ref, it = [], iter(pipe)
+    for _ in range(12):
+        try:
+            b = next(it)
+        except StopIteration:
+            it = iter(pipe)
+            b = next(it)
+        ref.append(float(tr.step(*b)))
+    pipe.close()
+
+    runner = resilience.ElasticRunner(
+        build, str(tmp_path / "root"), max_incarnations=2,
+        checkpoint_every=4, backoff_base_s=0.01, max_restarts=0)
+    resilience.chaos.configure(
+        {"step": {"fatal_calls": [7], "transient": False}}, seed=0)
+    try:
+        losses = runner.run(12)
+    finally:
+        resilience.chaos.disable()
+    assert runner.incarnation == 1          # exactly one rebuild
+    assert losses == ref

@@ -21,10 +21,21 @@ nonzero on any non-recovered failure or loss mismatch; emits a
 ``tools/telemetry_report.py`` shows the soak next to its retry/restart/
 checkpoint records.
 
+``--elastic`` (PR 7) runs the topology-loss scenario instead: the run
+starts on a 2-device mesh fed by 2 simulated input ranks, a fatal
+fault kills the incarnation mid-run (past the first checkpoint), and
+:class:`resilience.ElasticRunner` rebuilds on ONE device with ONE
+input rank — ``restore_sharded`` reshards the tensors onto the
+surviving mesh and the data sidecars re-partition the global sample
+position (a mid-restore ``checkpoint.restore`` fault is also injected
+and survived). The merged loss stream must STILL equal the
+uninterrupted 2-device reference bit-exactly.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_soak.py --steps 60 \
         --ckpt-every 10 --jsonl soak.jsonl
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --elastic --steps 40
     python tools/telemetry_report.py soak.jsonl
 
 A custom plan rides ``--plan`` (JSON) or the ``MXTPU_CHAOS`` knob.
@@ -57,6 +68,16 @@ DEFAULT_PLAN = {
 }
 #: a fatal step fault is scheduled relative to --steps (after the first
 #: checkpoint) in main(), so the restart path always runs
+
+#: --elastic plan: a transient step fault, a FATAL step fault that kills
+#: incarnation 0 (max_restarts=0, so it escalates to the ElasticRunner),
+#: and a mid-reshard restore fault the rebuilt incarnation must survive.
+#: The fatal call lands after the first checkpoint commits (set in
+#: main() relative to --ckpt-every).
+ELASTIC_PLAN = {
+    "step": {"at_calls": [4], "transient": True},
+    "checkpoint.restore": {"at_calls": [1]},
+}
 
 
 def build(seed: int):
@@ -122,11 +143,275 @@ def soak_run(steps: int, seed: int, ckpt_every: int, root: str,
     return losses, sup, events
 
 
+class SimShardedFeed:
+    """Simulates an N-process input fleet in one process: one pipeline
+    per simulated rank, each global batch the rank batches concatenated
+    in rank order. With ``shard`` ABOVE ``batch`` (``.batch(B)
+    .shard(r, N)``), rank ``r``'s ``t``-th batch is post-shuffle batch
+    ``t*N + r`` — so the concatenation is the natural contiguous global
+    batch and the global stream is IDENTICAL for every simulated rank
+    count. ``load_state_dict`` with a different saved rank count
+    re-partitions the global sample position via
+    ``data.state.reshard_iterator_states``."""
+
+    def __init__(self, pipes):
+        self.pipes = pipes
+
+    def __iter__(self):
+        import numpy as np
+
+        its = [iter(p) for p in self.pipes]
+        while True:
+            parts = []
+            for it in its:
+                try:
+                    parts.append(next(it))
+                except StopIteration:
+                    if parts:
+                        raise RuntimeError(
+                            "simulated ranks exhausted unevenly — the "
+                            "sample count does not split over the rank "
+                            "count")
+                    # epoch boundary: drive every sibling to ITS epoch
+                    # end too, so all pipes reset together on re-iter
+                    # (a rank with samples left means a ragged split)
+                    for other in its:
+                        if other is it:
+                            continue
+                        try:
+                            next(other)
+                        except StopIteration:
+                            continue
+                        else:
+                            raise RuntimeError(
+                                "simulated ranks exhausted unevenly — "
+                                "the sample count does not split over "
+                                "the rank count")
+                    return
+            yield tuple(np.concatenate([p[i] for p in parts])
+                        for i in range(len(parts[0])))
+
+    def state_dict(self):
+        return {"sim_ranks": len(self.pipes),
+                "ranks": [p.state_dict() for p in self.pipes]}
+
+    def load_state_dict(self, sd):
+        from incubator_mxnet_tpu.data import state as dstate
+
+        states = sd["ranks"]
+        if len(states) == len(self.pipes):
+            for p, s in zip(self.pipes, states):
+                p.load_state_dict(s)
+        else:
+            dstate.reshard_iterator_states(states, self.pipes)
+
+    def close(self):
+        for p in self.pipes:
+            p.close()
+
+
+def build_elastic(seed: int, sim_ranks: int, n_devices: int,
+                  global_batch: int = 16):
+    """Deterministic trainer on the first ``n_devices`` devices + a
+    ``sim_ranks``-way simulated sharded input fleet. The GLOBAL batch
+    (and therefore the loss stream) is invariant across both knobs."""
+    import jax
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu import data as mxdata
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=16, activation="relu"),
+            nn.Dense(8, in_units=32))
+    net.initialize(init="xavier")
+    mesh = parallel.make_mesh({"data": n_devices},
+                              devices=jax.devices()[:n_devices])
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    rs = np.random.RandomState(seed + 1)
+    x = rs.rand(256, 16).astype(np.float32)
+    y = rs.randint(0, 8, (256,)).astype(np.float32)
+    if global_batch % sim_ranks:
+        raise ValueError("global batch must divide over sim ranks")
+    per_rank = global_batch // sim_ranks
+    pipes = [(mxdata.from_ndarray(x, y)
+              .shuffle(64, seed=seed)
+              .batch(per_rank)
+              .shard(r, sim_ranks)
+              .prefetch(2))
+             for r in range(sim_ranks)]
+    return trainer, SimShardedFeed(pipes)
+
+
+def elastic_reference_run(steps: int, seed: int):
+    trainer, feed = build_elastic(seed, sim_ranks=2, n_devices=2)
+    losses, it = [], iter(feed)
+    for _ in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(feed)
+            batch = next(it)
+        losses.append(float(trainer.step(*batch)))
+    feed.close()
+    return losses
+
+
+def elastic_soak_run(steps: int, seed: int, ckpt_every: int, root: str,
+                     plan: dict, plan_seed: int, topo0, topo1):
+    """Incarnation 0 on topology ``topo0 = (sim_ranks, n_devices)``
+    dies to a fatal fault (no in-place restarts: max_restarts=0); the
+    ElasticRunner rebuilds on ``topo1`` and the reshard-restore
+    continues the run (surviving a mid-restore fault on the way)."""
+    from incubator_mxnet_tpu import resilience
+
+    def build_fn(incarnation):
+        return build_elastic(seed, *(topo0 if incarnation == 0
+                                     else topo1))
+
+    runner = resilience.ElasticRunner(
+        build_fn, root, max_incarnations=4,
+        manager_kwargs={"keep_last_k": 3},
+        checkpoint_every=ckpt_every, backoff_base_s=0.01,
+        max_restarts=0, seed=plan_seed)
+    resilience.chaos.configure(plan, seed=plan_seed)
+    try:
+        losses = runner.run(steps)
+    finally:
+        events = resilience.chaos.events()
+        resilience.chaos.disable()
+    return losses, runner, events
+
+
+def elastic_main(args, plan: dict, root: str) -> int:
+    """The ``--elastic`` scenarios (docs/RESILIENCE.md "Elastic
+    restart"). One uninterrupted 2-input-rank/2-device reference, then:
+
+    * **input-host loss** — incarnation 1 rebuilds with ONE input rank
+      on the SAME mesh, with the reshard planner forced on
+      (``MXTPU_RESHARD_MODE=always``): the merged loss stream must be
+      **bit-exact** — planner tensor restore and N->M sidecar
+      re-partitioning are both provably lossless;
+    * **chip loss** — incarnation 1 rebuilds on ONE device (and one
+      input rank): tensors restore bit-identically (the reshard matrix
+      tests prove that), but the loss stream is compared within float
+      tolerance — partitioning the batch over a different device count
+      changes XLA's reduction association order by design, so the last
+      ulp of a mean is not preserved across a mesh-size change.
+    """
+    import numpy as np
+
+    from incubator_mxnet_tpu.config import config
+
+    print(f"[chaos_soak] elastic reference run (2 input ranks, "
+          f"2 devices): {args.steps} steps", flush=True)
+    ref = elastic_reference_run(args.steps, args.seed)
+    scenarios = [
+        ("input_host_loss", (2, 2), (1, 2), 0.0),
+        ("chip_loss", (2, 2), (1, 1), 1e-5),
+    ]
+    results = []
+    failure = None
+    for name, topo0, topo1, atol in scenarios:
+        print(f"[chaos_soak] elastic scenario {name}: "
+              f"{topo0[0]} ranks/{topo0[1]} devices -> "
+              f"{topo1[0]} ranks/{topo1[1]} devices under plan "
+              f"{json.dumps(plan)}", flush=True)
+        sroot = os.path.join(root, name)
+        if name == "input_host_loss":
+            config.set("MXTPU_RESHARD_MODE", "always")
+        try:
+            losses, runner, events = elastic_soak_run(
+                args.steps, args.seed, args.ckpt_every, sroot, plan,
+                plan_seed=args.seed, topo0=topo0, topo1=topo1)
+        except BaseException as e:  # noqa: BLE001 — report, don't crash
+            failure = (f"{name}: soak did not complete: "
+                       f"{type(e).__name__}: {e}")
+            break
+        finally:
+            config.unset("MXTPU_RESHARD_MODE")
+        nans = sum(1 for v in losses if v != v)
+        if len(losses) != len(ref) or nans:
+            failure = (f"{name}: produced {len(losses)} losses "
+                       f"({nans} NaN), expected {len(ref)}")
+            break
+        # a run short enough that the fatal (or the mid-restore fault)
+        # never fired would pass the loss checks trivially — when the
+        # plan schedules those faults, refuse to claim the elastic
+        # path was exercised unless they actually fired
+        expects_fatal = bool(plan.get("step", {}).get("fatal_calls"))
+        expects_restore = "checkpoint.restore" in plan
+        restore_faults = sum(1 for e in events
+                             if e["site"] == "checkpoint.restore")
+        if (expects_fatal and runner.incarnation < 1) or \
+                (expects_restore and restore_faults < 1):
+            failure = (f"{name}: elastic path not exercised "
+                       f"(incarnations={runner.incarnation + 1}, "
+                       f"mid-restore faults={restore_faults}) — the "
+                       "fatal lands at step ckpt_every+3; increase "
+                       "--steps")
+            break
+        if atol == 0.0:
+            bad = sum(1 for a, b in zip(ref, losses) if a != b)
+            if bad:
+                failure = (f"{name}: {bad}/{len(ref)} losses differ "
+                           "bit-wise from the uninterrupted reference")
+                break
+        else:
+            worst = max(abs(a - b) for a, b in zip(ref, losses))
+            if worst > atol:
+                failure = (f"{name}: max loss deviation {worst:.3e} "
+                           f"exceeds {atol:.0e}")
+                break
+            bad = int(np.sum([a != b for a, b in zip(ref, losses)]))
+        results.append({
+            "scenario": name, "from": list(topo0), "to": list(topo1),
+            "incarnations": runner.incarnation + 1,
+            "faults_injected": len(events),
+            "fault_log": events, "exact": atol == 0.0,
+            "loss_mismatches": bad,
+        })
+    summary = {
+        "kind": "resilience", "event": "soak_summary", "elastic": True,
+        "steps": args.steps, "ok": failure is None,
+        "scenarios": results,
+    }
+    if failure:
+        summary["failure"] = failure
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.jsonl_emit(summary)
+    except Exception:
+        pass
+    print(json.dumps(summary))
+    if failure:
+        print(f"[chaos_soak] FAIL: {failure}", file=sys.stderr)
+        return 1
+    print(f"[chaos_soak] OK: {args.steps} steps x "
+          f"{len(results)} elastic scenarios "
+          "(input-host loss bit-exact; chip loss within float "
+          "tolerance), reshard-restore survived a mid-restore fault")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--ckpt-every", type=int, default=10)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--elastic", action="store_true",
+                    help="topology-loss scenario: kill the 2-device/"
+                         "2-input-rank incarnation mid-run, rebuild on "
+                         "1 device/1 rank via reshard-restore, assert "
+                         "the merged loss stream still matches the "
+                         "uninterrupted reference")
     ap.add_argument("--plan", type=str, default=None,
                     help="JSON chaos plan (default: the built-in "
                          "all-sites schedule; MXTPU_CHAOS also accepted)")
@@ -136,6 +421,19 @@ def main(argv=None) -> int:
                     help="telemetry JSONL sink path")
     args = ap.parse_args(argv)
 
+    if args.elastic and "jax" not in sys.modules:
+        # the elastic scenario needs >= 2 CPU devices; arrange the XLA
+        # flag BEFORE jax initializes (re-exec once if the operator
+        # didn't set it)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags \
+                and not os.environ.get("MXTPU_SOAK_REEXEC"):
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+            os.environ["MXTPU_SOAK_REEXEC"] = "1"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+
     if args.jsonl:
         os.environ["MXTPU_TELEMETRY_JSONL"] = args.jsonl
     if args.plan:
@@ -143,6 +441,12 @@ def main(argv=None) -> int:
     elif os.environ.get("MXTPU_CHAOS", "").strip():
         data = json.loads(os.environ["MXTPU_CHAOS"])
         plan = data.get("sites", data)
+    elif args.elastic:
+        plan = {k: dict(v) for k, v in ELASTIC_PLAN.items()}
+        # the incarnation-killing fatal lands after the first
+        # checkpoint commits, so the rebuilt topology has something to
+        # reshard-restore from
+        plan["step"]["fatal_calls"] = [max(args.ckpt_every + 3, 6)]
     else:
         plan = {k: dict(v) for k, v in DEFAULT_PLAN.items()}
         # a fatal step fault lands after the first checkpoint commits,
@@ -153,6 +457,12 @@ def main(argv=None) -> int:
 
     root = args.root or tempfile.mkdtemp(prefix="mxtpu-chaos-soak-")
     own_root = args.root is None
+
+    if args.elastic:
+        rc = elastic_main(args, plan, root)
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+        return rc
 
     print(f"[chaos_soak] reference run: {args.steps} steps", flush=True)
     ref = reference_run(args.steps, args.seed)
