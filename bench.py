@@ -50,6 +50,10 @@ ANCHORS = {
     # the full-gather rebuild (benchmark/reshard_bench.py); anchor 1.0 =
     # no better than gathering, so vs_baseline IS the reduction factor
     "reshard": 1.0,
+    # K-steps-per-dispatch amortization (benchmark/superstep_bench.py):
+    # geomean over the MLP/LSTM shapes of per_step(K=1)/per_step(K=32);
+    # anchor 1.0 = dispatch cost not amortized, so vs_baseline IS the win
+    "superstep": 1.0,
     "resnet50": 800.0,
 }
 
@@ -117,6 +121,116 @@ def _run_steps_fit(trainer, x, y):
         return time.perf_counter() - t0
 
     return _fit_windows(window)
+
+
+def _place_window(trainer, win, dtypes):
+    """Pre-place one stacked ``[K, ...]`` window on the mesh with the
+    trainer's window sharding (bench methodology: batches pre-placed so
+    the number measures chip throughput, not the feeder)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for w, dt in zip(win, dtypes):
+        a = jnp.asarray(w, dt) if dt is not None else jnp.asarray(w)
+        out.append(jax.device_put(a, trainer._window_sharding()))
+    return out
+
+
+def _superstep_fit(trainer, batch_fn, dtypes):
+    """Two-point fit over ``run_superstep`` windows of DISTINCT batches
+    (ISSUE 9: the recorded dispatch-bound configs drive the real
+    superstep engine — K distinct batches, one dispatch, a [K] per-step
+    loss stream — instead of run_steps' fixed-batch loop). ``batch_fn(i)``
+    yields the i-th distinct host batch; both window sizes warm first."""
+    import jax
+
+    from incubator_mxnet_tpu.parallel.superstep import stack_window
+
+    def mk(n, seed0):
+        return _place_window(
+            trainer, stack_window([batch_fn(seed0 + i) for i in range(n)]),
+            dtypes)
+
+    w1 = mk(ITERS, 0)
+    w2 = mk(ITERS2, 10_000)
+    jax.device_get(trainer.run_superstep(w1[0], w1[1]))
+    jax.device_get(trainer.run_superstep(w2[0], w2[1]))
+
+    def window(n):
+        w = w1 if n == ITERS else w2
+        t0 = time.perf_counter()
+        losses = trainer.run_superstep(w[0], w[1])
+        jax.device_get(losses)
+        return time.perf_counter() - t0
+
+    return _fit_windows(window)
+
+
+#: dispatch/host-overhead diagnostics of the LAST workload row (one
+#: config per subprocess, like LAST_FIT_STATS); run_one merges it into
+#: the emitted JSON line
+LAST_ROW_EXTRA = None
+
+
+def _dispatch_stats(trainer):
+    """Dispatches per step from the PR 4 StepMeter counters of THIS
+    trainer's meters — O(1/K) on a superstep/run_steps row, 1.0 on a
+    host-dispatched row (warmup included; it is a ratio)."""
+    d = s = 0.0
+    for name in ("_telemetry", "_loop_telemetry", "_superstep_telemetry"):
+        insts = getattr(getattr(trainer, name, None), "_insts", None)
+        if not insts:
+            continue
+        d += insts["dispatches"].value
+        s += insts["steps"].value
+    return (d / s) if s else None
+
+
+def _row_extra(trainer, args, per, mode):
+    """Attach ``dispatches_per_step`` and ``host_overhead_frac`` to the
+    row. ``host_overhead_frac`` = 1 - ondevice_per/dispatched_per: the
+    share of a host-dispatched step's wall time that the on-device loop
+    amortizes away (dispatch latency + per-step host work). ``mode`` says
+    which side ``per`` measured ('ondevice' for superstep/run_steps rows,
+    'dispatch' for per-step rows); the other side is measured here with
+    one short auxiliary fit. Never fails the row."""
+    global LAST_ROW_EXTRA
+    import jax
+
+    extra = {}
+    dps = _dispatch_stats(trainer)
+    if dps is not None:
+        extra["dispatches_per_step"] = round(dps, 4)
+    try:
+        if mode == "ondevice":
+            float(jax.device_get(trainer.step(*args)))
+
+            def win(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    loss = trainer.step(*args)
+                float(jax.device_get(loss))
+                return time.perf_counter() - t0
+
+            dispatched, ondevice = _fit_once(win, 3, 9), per
+        else:
+            float(jax.device_get(trainer.run_steps(3, *args)))
+            float(jax.device_get(trainer.run_steps(9, *args)))
+
+            def win(n):
+                t0 = time.perf_counter()
+                loss = trainer.run_steps(n, *args)
+                float(jax.device_get(loss))
+                return time.perf_counter() - t0
+
+            dispatched, ondevice = per, _fit_once(win, 3, 9)
+        if dispatched > 0 and ondevice > 0:
+            extra["host_overhead_frac"] = round(
+                max(0.0, 1.0 - ondevice / dispatched), 4)
+    except Exception:
+        pass
+    LAST_ROW_EXTRA = extra or None
 
 
 # Round-6 reproducibility fix (VERDICT r5 blocker #1): ONE two-point fit
@@ -215,10 +329,11 @@ def bench_mlp():
     Round-4 change (VERDICT item 4): a 3-layer MLP step is ~0.2 ms of
     compute but a host-dispatched step through the axon tunnel costs
     ~16 ms — the r3 number measured TUNNEL LATENCY, not the chip
-    (PROFILE.md "MLP decomposition"). The recorded config now drives
-    ``SPMDTrainer.run_steps`` (on-device fori_loop over fused steps —
-    the analog of the reference engine's async pipelining, one dispatch
-    per ITERS steps) at batch 8192/chip.
+    (PROFILE.md "MLP decomposition"). ISSUE 9: the recorded config now
+    drives ``SPMDTrainer.run_superstep`` (the real K-steps-per-dispatch
+    engine — K DISTINCT batches per dispatch, per-step losses back as a
+    [K] array) instead of run_steps' fixed-batch loop, at batch
+    8192/chip.
     """
     import jax
     import jax.numpy as jnp
@@ -240,10 +355,17 @@ def bench_mlp():
     trainer = parallel.SPMDTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
-    x = _place(mesh, np.random.rand(batch, 784).astype(np.float32),
-               jnp.bfloat16)
-    y = _place(mesh, np.random.randint(0, 10, (batch,)).astype(np.float32))
-    per = _run_steps_fit(trainer, x, y)
+
+    def batch_fn(i):
+        rs = np.random.RandomState(i)
+        return (rs.rand(batch, 784).astype(np.float32),
+                rs.randint(0, 10, (batch,)).astype(np.float32))
+
+    per = _superstep_fit(trainer, batch_fn, [jnp.bfloat16, None])
+    bx, by = batch_fn(0)
+    x = _place(mesh, bx, jnp.bfloat16)
+    y = _place(mesh, by)
+    _row_extra(trainer, (x, y), per, "ondevice")
     return (batch / per / n_dev, "images/sec/chip",
             "mlp_mnist_train_throughput_per_chip", "mlp",
             _tfs(trainer, (x, y), per, n_dev))
@@ -253,11 +375,12 @@ def bench_lstm_ptb():
     """config[3]: LSTM PTB medium (2x650, seq 35, batch 20) — the cuDNN-RNN
     capability over lax.scan.
 
-    Round 5: drives ``run_steps`` (on-device loop, one dispatch per
-    window) like the MLP config — a PTB step is a few ms of scan-heavy
-    compute, so per-step host dispatch through the tunnel was a
-    material fraction of the old number; the reference's async engine
-    pipelines step dispatch identically."""
+    Round 5 drove ``run_steps`` (fixed-batch on-device loop); ISSUE 9
+    upgrades the row to ``run_superstep`` — K DISTINCT batches per
+    dispatch with the per-step loss stream — a PTB step is a few ms of
+    scan-heavy compute, so per-step host dispatch through the tunnel
+    was the ceiling; the reference's async engine pipelines step
+    dispatch identically."""
     import jax
 
     import incubator_mxnet_tpu as mx
@@ -278,10 +401,17 @@ def bench_lstm_ptb():
     trainer = parallel.SPMDTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 1.0, "clip_gradient": 0.25}, mesh=mesh)
-    data = np.random.randint(0, V, (B, T + 1))
-    x = _place(mesh, data[:, :-1].astype(np.int32))
-    y = _place(mesh, data[:, 1:].astype(np.float32))
-    per = _run_steps_fit(trainer, x, y)
+
+    def batch_fn(i):
+        rs = np.random.RandomState(i)
+        d = rs.randint(0, V, (B, T + 1))
+        return (d[:, :-1].astype(np.int32), d[:, 1:].astype(np.float32))
+
+    per = _superstep_fit(trainer, batch_fn, [None, None])
+    bx, by = batch_fn(0)
+    x = _place(mesh, bx)
+    y = _place(mesh, by)
+    _row_extra(trainer, (x, y), per, "ondevice")
     return (B * T / per / n_dev, "tokens/sec/chip",
             "lstm_ptb_train_throughput_per_chip", "lstm_ptb",
             _tfs(trainer, (x, y), per, n_dev))
@@ -321,6 +451,7 @@ def bench_bert():
     mlm_y = _place(mesh, np.random.randint(0, V, (B, T)).astype(np.float32))
     nsp_y = _place(mesh, np.random.randint(0, 2, (B,)).astype(np.float32))
     per = _timed_steps(trainer, ([tok, seg, vl], [mlm_y, nsp_y]))
+    _row_extra(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), per, "dispatch")
     return (B / per / n_dev, "sequences/sec/chip",
             "bert_base_pretrain_throughput_per_chip", "bert_base",
             _tfs(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), per, n_dev))
@@ -369,6 +500,7 @@ def bench_ssd():
                        cx + w / 2, cy + h / 2]
     y = _place(mesh, label)
     per = _timed_steps(trainer, (x, y))
+    _row_extra(trainer, (x, y), per, "dispatch")
     return (B / per / n_dev, "images/sec/chip",
             "ssd300_train_throughput_per_chip", "ssd300",
             _tfs(trainer, (x, y), per, n_dev))
@@ -398,6 +530,7 @@ def bench_resnet():
                jnp.bfloat16)
     y = _place(mesh, np.random.randint(0, 1000, (batch,)).astype(np.float32))
     per = _timed_steps(trainer, (x, y))
+    _row_extra(trainer, (x, y), per, "dispatch")
     return (batch / per / n_dev, "images/sec/chip",
             "resnet50_v1_train_throughput_per_chip", "resnet50",
             _tfs(trainer, (x, y), per, n_dev))
@@ -492,6 +625,29 @@ def bench_reshard():
             "reshard_peak_host_reduction", "reshard", None)
 
 
+def bench_superstep():
+    """config[8]: K-steps-per-dispatch sweep — per-step wall time at
+    K in {1, 8, 32} for the MLP and LSTM dispatch-bound shapes through
+    the WHOLE superstep engine (window stacking + staging + the compiled
+    K-step loop; benchmark/superstep_bench.py). The recorded value is
+    the geomean over both models of per_step(K=1)/per_step(K=32); anchor
+    1.0, so ``vs_baseline`` IS the dispatch-amortization win. Per-point
+    (model, K) rows ride the JSONL mirror so BENCH_r06 can place the
+    knee. No MFU row — the headline MLP/LSTM rows carry it."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.superstep_bench import geomean_speedup, sweep
+
+    per_model = sweep()
+    val = geomean_speedup(per_model)
+    if val <= 0:
+        raise RuntimeError("superstep sweep produced no timings")
+    return (val, "x_speedup_k32_vs_k1_geomean",
+            "superstep_dispatch_amortization", "superstep", None)
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "lstm_ptb": bench_lstm_ptb,
@@ -500,6 +656,7 @@ CONFIGS = {
     "data_pipeline": bench_data_pipeline,
     "resilience": bench_resilience,
     "reshard": bench_reshard,
+    "superstep": bench_superstep,
     "resnet50": bench_resnet,  # headline — always last
 }
 
@@ -535,6 +692,8 @@ def run_one(key):
         if tfs:
             line["tfs"] = round(tfs, 2)
             line["mfu_pct"] = round(_mfu_pct(tfs), 1)
+        if LAST_ROW_EXTRA is not None:
+            line.update(LAST_ROW_EXTRA)
         if LAST_FIT_STATS is not None:
             line["fit"] = LAST_FIT_STATS
         _jsonl_emit({"kind": "bench", **line})

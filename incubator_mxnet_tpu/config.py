@@ -248,6 +248,24 @@ config.register(
     "pool shuffle, the reference iterator's shuffle_chunk analog). "
     "Larger = closer to a uniform shuffle, more resident samples.")
 config.register(
+    "MXTPU_SUPERSTEP", "auto", str,
+    "K-steps-per-dispatch training (docs/TRAINING.md 'Superstep'): "
+    "'auto' (default) compiles the whole K-step loop into ONE donated "
+    "executable wherever a caller drives stacked windows "
+    "(SPMDTrainer.run_superstep/superstep_feed, gluon "
+    "Trainer.superstep) and the step is fusable, with transparent "
+    "per-step fallback (sparse grads, amp, update_on_kvstore, rules "
+    "without a functional core); '0'/'off' forces the fallback — the "
+    "identical per-step loss stream, K host dispatches.")
+config.register(
+    "MXTPU_SUPERSTEP_WINDOW", 8, int,
+    "Default superstep window K: batches stacked per dispatch by "
+    "data pipeline .window() stages and SPMDTrainer.superstep_feed. "
+    "The knee is workload-dependent (benchmark/superstep_bench.py "
+    "sweeps K in {1,8,32}); raising K amortizes dispatch latency over "
+    "more steps but lengthens the checkpoint cadence quantum and the "
+    "H2D window buffer.")
+config.register(
     "MXTPU_RESILIENCE_MAX_RETRIES", 3, int,
     "Transient-failure retry budget per supervised step (and per batch "
     "fetch) before the resilience Supervisor escalates to a "

@@ -90,7 +90,8 @@ class DevicePrefetcher:
     """
 
     def __init__(self, source: Iterable, sharding=None,
-                 depth: Optional[int] = None, site: str = "data"):
+                 depth: Optional[int] = None, site: str = "data",
+                 steps_per_item: int = 1):
         from ..config import config
 
         self._source = source
@@ -99,6 +100,14 @@ class DevicePrefetcher:
             depth = int(config.get("MXTPU_DATA_PREFETCH_DEPTH"))
         self.depth = max(1, int(depth))
         self.site = site
+        # >1 when each delivered item is a stacked superstep window of
+        # (nominally) that many batches (SPMDTrainer.superstep_feed):
+        # the batch counter and the JSONL records carry the factor so
+        # tools/telemetry_report.py stays per-batch apples-to-apples
+        # against non-superstep runs. Short tail windows count their
+        # ACTUAL length (the delivered leading dim), not the nominal K.
+        self.steps_per_item = max(1, int(steps_per_item))
+        self._batches_exact = 0      # batch-granular delivery count
         self._producer = None        # _QueueProducer while an epoch runs
         self._delivered = 0          # this epoch (absolute within epoch)
         self._resume_base = 0        # set by load_state_dict
@@ -153,6 +162,10 @@ class DevicePrefetcher:
         rec: Dict[str, Any] = {"kind": "data", "site": self.site,
                                "batches": self._delivered,
                                "queue_depth": self.queue_depth()}
+        if self.steps_per_item > 1:
+            rec["superstep"] = self.steps_per_item
+            # exact per-batch count: tail windows run short of K
+            rec["batches_exact"] = self._batches_exact
         if self._bound_ema is not None:
             rec["input_bound_pct"] = round(100.0 * self._bound_ema, 2)
         if final:
@@ -195,6 +208,9 @@ class DevicePrefetcher:
         # after a mid-epoch restore the delivered count continues from
         # the restored cursor so a later state_dict() stays absolute
         self._delivered = self._resume_base
+        # batch-granular mirror (nominal-K approximation after a
+        # mid-epoch restore; exact for fresh epochs)
+        self._batches_exact = self._delivered * self.steps_per_item
         self._resume_base = 0
         self._last_return = None
         self._spawn_producer()
@@ -241,10 +257,25 @@ class DevicePrefetcher:
             insts["bound"].set(self._bound_ema)
         self._last_return = now
         self._delivered += 1
-        insts["batches"].inc()
+        steps = self._item_steps(item)
+        self._batches_exact += steps
+        insts["batches"].inc(steps)                 # batch-granular
         if self._delivered % _JSONL_EVERY == 0:
             self._emit()
         return item
+
+    def _item_steps(self, item) -> int:
+        """Batches one delivered item stands for: 1 normally; the ACTUAL
+        window length (leading dim of the first array leaf) for a
+        superstep feed — a short tail window counts what it holds."""
+        if self.steps_per_item <= 1:
+            return 1
+        leaf = item
+        while isinstance(leaf, (tuple, list, dict)) and len(leaf):
+            leaf = next(iter(leaf.values())) if isinstance(leaf, dict) \
+                else leaf[0]
+        shape = getattr(leaf, "shape", None)
+        return int(shape[0]) if shape else self.steps_per_item
 
     def queue_depth(self) -> int:
         """Batches currently staged on device ahead of the consumer."""

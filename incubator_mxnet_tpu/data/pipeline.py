@@ -205,6 +205,19 @@ class Stage:
         batch is emitted unless ``drop_last``."""
         return _Batch(self, batch_size, drop_last)
 
+    def window(self, size: Optional[int] = None) -> "Stage":
+        """Stack ``size`` consecutive items (typically whole batches
+        from a ``batch`` stage) into one ``[K, ...]`` window along a new
+        leading axis — the host half of the superstep engine
+        (docs/TRAINING.md "Superstep"): ``SPMDTrainer.superstep_feed``
+        stages these windows on device and ``run_superstep`` trains K
+        steps in one dispatch. The epoch's tail (fewer than ``size``
+        items left, or a partial final batch whose shape cannot stack
+        with the full ones) is emitted as a SHORT window — it becomes a
+        short tail superstep, never dropped samples. Default size from
+        ``MXTPU_SUPERSTEP_WINDOW``."""
+        return _Window(self, size)
+
     def shuffle(self, buffer_size: Optional[int] = None,
                 seed: int = 0) -> "Stage":
         """Streaming pool shuffle (the reference iterator's
@@ -580,6 +593,110 @@ class _Batch(Stage):
         # mid-epoch checkpoints sit on full-batch boundaries (a partial
         # batch is only ever the epoch's last), so this is exact
         self._source._skip(n * self.batch_size)
+        self._cursor += n
+
+
+def _leaf_shapes(item):
+    """Structural shape fingerprint of one item — windows only stack
+    shape-identical batches (a partial final batch leads its own tail
+    window instead of breaking np.stack)."""
+    if isinstance(item, (tuple, list)):
+        return tuple(_leaf_shapes(v) for v in item)
+    if isinstance(item, dict):
+        return tuple((k, _leaf_shapes(item[k])) for k in sorted(item))
+    return tuple(np.shape(item))
+
+
+class _Window(Stage):
+    """Stack ``size`` consecutive upstream items into one ``[K, ...]``
+    window (leaf-wise ``np.stack``). Epoch tails come out short: the
+    last window holds whatever full-shape run remains, and a partial
+    final batch (different leaf shapes) is held back to lead its own
+    final window — the K-doesn't-divide-epoch case trains a short tail
+    superstep instead of dropping samples or hanging
+    (tests/test_data_pipeline.py)."""
+
+    kind = "window"
+
+    def __init__(self, source: Stage, size: Optional[int]):
+        super().__init__(source)
+        if size is None:
+            size = int(_cfg("MXTPU_SUPERSTEP_WINDOW"))
+        if size < 1:
+            raise ValueError(f"window size must be >= 1, got {size}")
+        self.size = int(size)
+        self._held = None          # shape-breaking batch for the next window
+        # (windows_emitted, upstream_items_in_them): ONE tuple, assigned
+        # atomically in _next, so a state_dict() taken from another
+        # thread (a live DevicePrefetcher producer mid-window) can never
+        # observe a torn pair — the recordio _pos discipline. A held
+        # shape-breaking batch is NOT counted: it was pulled but not
+        # delivered, so a restore must re-pull it.
+        self._pos = (0, 0)
+        self._pending_resume = None   # (cursor_snap, consumed) from restore
+
+    def _start_epoch(self):
+        self._held = None
+        self._pos = (0, 0)
+
+    def _own_state(self):
+        # window_size is the step-granularity conversion factor
+        # data.state needs across topology changes; (cursor_snap,
+        # consumed) is the exact upstream position for the resume fast
+        # path in _skip
+        emitted, consumed = self._pos
+        return {"window_size": self.size, "consumed": consumed,
+                "cursor_snap": emitted}
+
+    def _load_own_state(self, sd):
+        if "consumed" in sd:
+            self._pending_resume = (
+                int(sd.get("cursor_snap", sd["cursor"])),
+                int(sd["consumed"]))
+
+    def _next(self):
+        # any pull before the restore skip means an upstream stage is
+        # replaying from epoch start — the resume fast path no longer
+        # applies (the recordio pending-seek discipline)
+        self._pending_resume = None
+        src = self._source
+        items = []
+        if self._held is not None:
+            items.append(self._held)
+            self._held = None
+        while len(items) < self.size:
+            try:
+                nxt = src._pull()
+            except StopIteration:
+                break
+            if items and _leaf_shapes(nxt) != _leaf_shapes(items[0]):
+                self._held = nxt
+                break
+            items.append(nxt)
+        if not items:
+            raise StopIteration
+        self._pos = (self._pos[0] + 1, self._pos[1] + len(items))
+        return _stack(items)
+
+    def _skip(self, n: int):
+        # restore fast path: when the skip count IS the recorded
+        # snapshot, the recorded upstream position is exact even when
+        # delivered windows ran SHORT (a held partial batch mid-window,
+        # the epoch's tail) — an n*size stride would overshoot and
+        # silently drop the held batch's window
+        pending, self._pending_resume = self._pending_resume, None
+        if pending is not None and self._cursor == 0 and n == pending[0]:
+            self._source._skip(pending[1])
+            self._pos = (n, pending[1])
+            self._cursor = n
+            return
+        # no matching snapshot (a DevicePrefetcher rewound the cursor
+        # below windows the producer had staged ahead, a pre-fix
+        # sidecar, a mid-epoch stride): re-produce and discard —
+        # always exact, including short windows, and no slower than
+        # the upstream chain's own replay (shuffle has no O(1) skip)
+        for _ in range(n):
+            self._next()
         self._cursor += n
 
 

@@ -87,9 +87,12 @@ def _jsonable(obj):
 # downstream of it, and the same stage kinds (ignoring batch/shard/
 # prefetch placement) on both sides of the topology change.
 
-#: stage kinds that neither change sample granularity nor depend on the
-#: rank count — ignored when comparing chain structure across topologies
-_NEUTRAL_KINDS = ("batch", "shard", "prefetch", "device_prefetch")
+#: stage kinds that neither change the item stream's content nor depend
+#: on the rank count — ignored when comparing chain structure across
+#: topologies (batch/window change granularity; their sizes are folded
+#: into the global sample position below)
+_NEUTRAL_KINDS = ("batch", "window", "shard", "prefetch",
+                  "device_prefetch")
 
 
 def _state_chain(sd: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -140,13 +143,14 @@ def _chain_info(chain: Sequence[Dict[str, Any]], what: str):
             continue
         if kind not in _NEUTRAL_KINDS:
             kinds.append(kind)
-        if kind == "batch":
-            if "batch_size" not in node:
+        if kind in ("batch", "window"):
+            size_key = "batch_size" if kind == "batch" else "window_size"
+            if size_key not in node:
                 raise ValueError(
-                    f"{what}: batch stage state carries no batch_size — "
+                    f"{what}: {kind} stage state carries no {size_key} — "
                     "sidecar predates topology-portable resharding; "
                     "restore on the saving rank count instead")
-            b = int(node["batch_size"])
+            b = int(node[size_key])
             mult *= b
             if shard is None:
                 above *= b
@@ -182,6 +186,46 @@ def _live_chain_states(stages: Sequence[Any]) -> List[Dict[str, Any]]:
     for parent, child in zip(nodes, nodes[1:]):
         parent["source"] = child
     return nodes
+
+
+def _chain_consumed_samples(sd: Dict[str, Any],
+                            chain: Sequence[Dict[str, Any]],
+                            mult: int, what: str) -> int:
+    """Post-shuffle samples this rank's chain consumed — exact under
+    SHORT windows: ``_Window`` emits short windows at the epoch's tail
+    (and before a held partial batch), so ``cursor * window_size`` can
+    overcount; the window node records the upstream items it actually
+    delivered (``consumed``) and the matching window count
+    (``cursor_snap``), which place the position exactly. Refuses (loud,
+    never silent sample loss) the one ambiguous case: a cursor rewound
+    below the recorded snapshot after short windows were produced."""
+    cursor = int(sd["cursor"])
+    wins = [n for n in chain if n.get("kind") == "window"]
+    if not wins:
+        return cursor * mult
+    if len(wins) > 1:
+        raise ValueError(
+            f"{what}: more than one window stage — the global sample "
+            "position is ambiguous; reshard supports at most one")
+    w = wins[0]
+    size = int(w["window_size"])
+    sub = mult // size                  # samples per window-input item
+    if "consumed" not in w:             # pre-PR8 window sidecar
+        return cursor * mult
+    consumed = int(w["consumed"])
+    snap = int(w.get("cursor_snap", 0))
+    if snap == cursor:
+        return consumed * sub           # exact, shorts included
+    if consumed == size * snap:
+        # every window produced so far was full, so the delivered
+        # prefix (cursor may trail snap: a DevicePrefetcher had
+        # windows staged ahead) is full-window-exact too
+        return cursor * mult
+    raise ValueError(
+        f"{what}: the resume position includes a short window the "
+        "sidecar cannot place exactly across a topology change — "
+        "resume on the saving rank count, or checkpoint on "
+        "full-window boundaries")
 
 
 def _chain_epoch(chain: Sequence[Dict[str, Any]]) -> int:
@@ -235,8 +279,10 @@ def reshard_iterator_state(states: Sequence[Dict[str, Any]],
             f"saved ranks disagree on the epoch ({sorted(epochs)}) — "
             "not a synchronized checkpoint")
     epoch = epochs.pop()
-    g = sum(int(sd["cursor"]) * info[0]
-            for sd, info in zip(states, old_infos))
+    g = sum(_chain_consumed_samples(sd, chain, info[0],
+                                    f"saved rank {i}")
+            for i, (sd, chain, info) in enumerate(
+                zip(states, old_chains, old_infos)))
 
     # new side: this rank's slice of [0, g)
     top, wrap = _unwrap_target(it)

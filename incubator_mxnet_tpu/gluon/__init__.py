@@ -4,7 +4,7 @@
 from . import loss, nn, utils
 from .block import Block, CachedOp, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict
-from .trainer import FusedStep, Trainer
+from .trainer import FusedStep, SuperStep, Trainer
 
 
 def __getattr__(name):

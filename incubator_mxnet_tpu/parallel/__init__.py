@@ -19,6 +19,8 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
 from .collectives import (allreduce_across_processes, allreduce_arrays,
                           init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
+from . import superstep
+from .superstep import stack_window, superstep_window
 from .pipeline import (PipelineTrainer, pipeline_apply,
                        pipeline_apply_1f1b, pipeline_apply_interleaved,
                        stack_stage_params)

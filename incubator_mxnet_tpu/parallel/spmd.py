@@ -123,6 +123,44 @@ def shard_params(net, rules: Dict[str, PartitionSpec]) -> None:
                 break
 
 
+def make_functional_loss(net, loss_fn, trainable_objs, frozen_objs):
+    """Build the pure ``(train_p, frozen_p, rng, data, labels) ->
+    (mean_loss, aux)`` closure over a Block + loss: parameter values are
+    injected via the ``_Trace`` mechanism, RNG draws route through
+    ``key_provider`` so dropout masks derive from the step's key, and
+    ``aux`` carries mutated auxiliary state (BatchNorm running stats) by
+    parameter name. Shared by ``SPMDTrainer._build_step`` and the gluon
+    ``SuperStep`` engine (gluon/trainer.py) so both compile the same
+    step body."""
+
+    def loss_of(train_p, frozen_p, rng, data_arrays, label_arrays):
+        param_map = {}
+        for n, p in trainable_objs.items():
+            param_map[id(p)] = NDArray(train_p[n])
+        for n, p in frozen_objs.items():
+            param_map[id(p)] = NDArray(frozen_p[n])
+        trace = _Trace(param_map)
+        _trace.stack.append(trace)
+        try:
+            with _random.key_provider(rng), \
+                    autograd._RecordingStateScope(False, True):
+                ins = [NDArray(a) for a in data_arrays]
+                out = net.forward(*ins)
+                outs = out if isinstance(out, tuple) else (out,)
+                labels = [NDArray(a) for a in label_arrays]
+                loss = loss_fn(*outs, *labels)
+        finally:
+            _trace.stack.pop()
+        loss_val = jnp.mean(loss._data.astype(jnp.float32))
+        id2name = {id(p): n for n, p in frozen_objs.items()}
+        id2name.update({id(p): n for n, p in trainable_objs.items()})
+        aux = {id2name[i]: v for i, (p, v) in trace.aux.items()
+               if i in id2name}
+        return loss_val, aux
+
+    return loss_of
+
+
 class SPMDTrainer:
     """Own the params as a sharded pytree; run fused jitted train steps.
 
@@ -151,6 +189,11 @@ class SPMDTrainer:
         self._donate = donate
         self._telemetry = telemetry.StepMeter("spmd.step")
         self._loop_telemetry = telemetry.StepMeter("spmd.run_steps")
+        self._superstep_telemetry = telemetry.StepMeter("spmd.superstep")
+        # nominal K of the superstep feed driving this trainer (set by
+        # superstep_feed); resilience.Supervisor scales its hung-step
+        # deadline by it so a K-times-longer dispatch is not a hang
+        self.superstep_window = 1
         self._flops_cache: Dict[Any, Optional[float]] = {}
         telemetry.maybe_start_http()
 
@@ -212,34 +255,9 @@ class SPMDTrainer:
 
     # -- the fused step -----------------------------------------------------
     def _build_step(self, n_data: int, n_label: int):
-        net, loss_fn, tx = self.net, self.loss_fn, self.tx
-        trainable_objs = self._trainable
-        frozen_objs = self._frozen
-
-        def loss_of(train_p, frozen_p, rng, data_arrays, label_arrays):
-            param_map = {}
-            for n, p in trainable_objs.items():
-                param_map[id(p)] = NDArray(train_p[n])
-            for n, p in frozen_objs.items():
-                param_map[id(p)] = NDArray(frozen_p[n])
-            trace = _Trace(param_map)
-            _trace.stack.append(trace)
-            try:
-                with _random.key_provider(rng), \
-                        autograd._RecordingStateScope(False, True):
-                    ins = [NDArray(a) for a in data_arrays]
-                    out = net.forward(*ins)
-                    outs = out if isinstance(out, tuple) else (out,)
-                    labels = [NDArray(a) for a in label_arrays]
-                    loss = loss_fn(*outs, *labels)
-            finally:
-                _trace.stack.pop()
-            loss_val = jnp.mean(loss._data.astype(jnp.float32))
-            id2name = {id(p): n for n, p in frozen_objs.items()}
-            id2name.update({id(p): n for n, p in trainable_objs.items()})
-            aux = {id2name[i]: v for i, (p, v) in trace.aux.items()
-                   if i in id2name}
-            return loss_val, aux
+        tx = self.tx
+        loss_of = make_functional_loss(self.net, self.loss_fn,
+                                       self._trainable, self._frozen)
 
         from ..config import matmul_precision_for
 
@@ -272,9 +290,9 @@ class SPMDTrainer:
 
     @staticmethod
     def _as_jax(x):
-        if isinstance(x, NDArray):
-            return x._data
-        return jnp.asarray(x)
+        from .superstep import as_jax
+
+        return as_jax(x)
 
     def device_prefetcher(self, source, depth: Optional[int] = None):
         """The preferred feed for :meth:`step` (docs/DATA.md): wrap a
@@ -462,6 +480,147 @@ class SPMDTrainer:
                     self.params, self.frozen, self.opt_state, rng,
                     data_arrays, label_arrays)
         return loss
+
+    # -- superstep: K distinct batches per dispatch -------------------------
+    def _window_sharding(self) -> NamedSharding:
+        from .superstep import window_spec
+
+        return NamedSharding(self.mesh,
+                             window_spec(self._batch_sharding.spec))
+
+    def superstep_feed(self, source, window: Optional[int] = None,
+                       depth: Optional[int] = None):
+        """The feed for :meth:`run_superstep` (docs/TRAINING.md
+        "Superstep"): stacks windows of ``window`` distinct batches from
+        ``source`` (an ``mxtpu.data`` pipeline, or any re-iterable of
+        ``(data, labels)`` batches) and stages them on the mesh with the
+        window sharding, double-buffered — window N+1's H2D overlaps
+        window N's training::
+
+            feed = st.superstep_feed(pipe, window=8)
+            for win in feed:
+                losses = st.run_superstep(*win)   # ONE dispatch, [8] losses
+
+        Resumable like any DevicePrefetcher feed: the window stage's
+        cursor counts windows, so a checkpoint at a superstep boundary
+        advances the data sidecar by exactly ``window`` batches per
+        superstep. The epoch's tail (fewer than ``window`` batches left)
+        comes out as a short window — :meth:`run_superstep` runs it as a
+        short tail superstep, no sample is dropped."""
+        from ..data import DevicePrefetcher
+        from ..data.pipeline import Stage, from_iter
+        from .superstep import superstep_window
+
+        k = superstep_window() if window is None else max(1, int(window))
+        if not isinstance(source, Stage):
+            src = from_iter(lambda: iter(source))
+        else:
+            src = source
+        self.superstep_window = k
+        return DevicePrefetcher(src.window(k),
+                                sharding=self._window_sharding(),
+                                depth=depth, site="spmd.superstep.data",
+                                steps_per_item=k)
+
+    def run_superstep(self, data, labels):
+        """Train on K *distinct* batches in ONE dispatch: ``data``/
+        ``labels`` leaves are stacked ``[K, ...]`` windows (from
+        :meth:`superstep_feed`, ``data.Stage.window`` or
+        ``superstep.stack_window``); the compiled ``lax.fori_loop`` body
+        slices batch ``i`` with ``dynamic_index_in_dim`` and runs the
+        same fused step body ``step`` compiles. Returns the ``[K]``
+        per-step loss array, so the loss stream stays per-step.
+
+        Bit-exactness contract (tests/test_superstep.py): the loss
+        stream, every dropout draw, and the final params equal K
+        individual ``step()`` calls on the same batches — per-iteration
+        keys are the exact ``next_key()`` sequence via
+        ``random.reserve_keys``. With ``MXTPU_SUPERSTEP=0`` this method
+        transparently falls back to exactly those K dispatches."""
+        from .superstep import (per_iteration_key, slice_window,
+                                superstep_enabled, window_len)
+
+        # chaos sites fire at superstep entry — before the RNG counter
+        # reservation or any state mutation, so a supervised retry of a
+        # failed superstep replays the identical K steps
+        from ..resilience import chaos
+
+        chaos.maybe_inject("step", detail="spmd.superstep")
+        chaos.maybe_inject("step.slow", detail="spmd.superstep")
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        wsh = self._window_sharding()
+        data_arrays = [jax.device_put(self._as_jax(d), wsh) for d in data]
+        label_arrays = [jax.device_put(self._as_jax(l), wsh)
+                        for l in labels]
+        k = window_len(data_arrays + label_arrays)
+        # advertise the window even when the caller stacked it by hand
+        # (no superstep_feed): the Supervisor's hung-step deadline and
+        # superstep-loss accounting key off this attribute
+        if k > self.superstep_window:
+            self.superstep_window = k
+        if not superstep_enabled():
+            # transparent fallback: the same K steps, host-dispatched
+            losses = [self.step([a[i] for a in data_arrays],
+                                [a[i] for a in label_arrays])
+                      for i in range(k)]
+            return jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+        key = ("superstep", k,
+               tuple((a.shape, str(a.dtype)) for a in data_arrays),
+               tuple((a.shape, str(a.dtype)) for a in label_arrays))
+        fn = self._step_cache.get(key)
+        miss = fn is None
+        if miss:
+            raw = self._build_step(len(data_arrays), len(label_arrays))
+
+            def superstep(train_p, frozen_p, opt_state, base_key, c0,
+                          data_w, label_w):
+                def body(i, carry):
+                    tp, fp, os_, losses = carry
+                    rng = per_iteration_key(base_key, c0, i)
+                    tp, fp, os_, loss = raw(tp, fp, os_, rng,
+                                            slice_window(data_w, i),
+                                            slice_window(label_w, i))
+                    return tp, fp, os_, losses.at[i].set(
+                        loss.astype(jnp.float32))
+
+                init = (train_p, frozen_p, opt_state,
+                        jnp.zeros((k,), jnp.float32))
+                return jax.lax.fori_loop(0, k, body, init)
+
+            fn = jax.jit(superstep, donate_argnums=(0, 1, 2)
+                         if self._donate else ())
+            self._step_cache[key] = fn
+        base_key, c0 = _random.reserve_keys(k)
+        from .mesh import mesh_scope
+
+        # per-step MFU uses the SINGLE-step executable's flops; the
+        # sliced first batch has exactly the per-step signature
+        skey = (tuple((a.shape[1:], str(a.dtype)) for a in data_arrays),
+                tuple((a.shape[1:], str(a.dtype)) for a in label_arrays))
+        h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
+        try:
+            with self._superstep_telemetry.step(
+                    h2d_bytes=h2d, count=k,
+                    flops_fn=lambda: self._flops_for(
+                        skey, [a[0] for a in data_arrays],
+                        [a[0] for a in label_arrays])):
+                if miss:
+                    telemetry.note_cache_miss("spmd.superstep",
+                                              detail=f"k={k}")
+                with mesh_scope(self.mesh):
+                    (self.params, self.frozen, self.opt_state,
+                     losses) = fn(self.params, self.frozen,
+                                  self.opt_state, base_key,
+                                  jnp.asarray(c0, jnp.uint32),
+                                  data_arrays, label_arrays)
+        except BaseException:
+            # zero steps executed (trace/compile failure, OOM): restore
+            # the RNG counter so a supervised retry replays identically
+            _random.rollback_keys(c0)
+            raise
+        self._num_steps += k
+        return losses
 
     def sync_to_net(self) -> None:
         """Write the trainer-owned arrays back into the Block's Parameters

@@ -1,0 +1,126 @@
+"""Superstep: K training steps per host dispatch (docs/TRAINING.md).
+
+BENCH_r05 pinned the small-model configs (MLP 7.1% MFU, LSTM 7.2%) on
+per-step host round-trips, not compute — the exact gap TF's in-graph
+loops (arXiv:1605.08695) and whole-loop XLA offload (arXiv:1810.09868)
+close. The superstep engine generalizes ``SPMDTrainer.run_steps`` from
+a fixed-batch ``lax.fori_loop`` into a loop over K *distinct* batches:
+
+* the host stacks a window of K batches from the ``mxtpu.data``
+  pipeline into a ``[K, ...]`` buffer (``Stage.window``) and a
+  ``DevicePrefetcher`` stages it on device with the window sharding,
+  so window N+1's H2D overlaps window N's training (double-buffered);
+* the compiled loop body indexes ``lax.dynamic_index_in_dim`` per
+  iteration and per-step losses come back as a ``[K]`` array, so the
+  loss stream stays per-step;
+* per-iteration RNG keys are the exact keys K individual ``step()``
+  calls would draw (``random.reserve_keys``) — the loss stream of a
+  superstep is bit-identical to K host-dispatched steps.
+
+This module holds the pieces shared by ``SPMDTrainer.run_superstep``
+(parallel/spmd.py) and the gluon ``SuperStep`` engine (gluon/trainer.py):
+knob resolution, window sharding/introspection, and host-side window
+stacking for feeds that are not ``mxtpu.data`` pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import numpy as np
+
+__all__ = ["as_jax", "per_iteration_key", "slice_window", "stack_window",
+           "superstep_enabled", "superstep_window", "window_len",
+           "window_spec"]
+
+
+def as_jax(x):
+    """Unwrap an NDArray (or convert any array-like) to a jax array —
+    THE shared input normalization of both superstep engines."""
+    from ..ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x._data
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def _cfg(name: str):
+    from ..config import config
+
+    return config.get(name)
+
+
+def superstep_enabled() -> bool:
+    """The ``MXTPU_SUPERSTEP`` knob: ``auto``/``1`` (default) engage the
+    K-steps-per-dispatch executable wherever the caller drives windows
+    and the step is fusable; ``0``/``off`` forces the transparent
+    fallback (K individual dispatches — same loss stream, no fusion)."""
+    return str(_cfg("MXTPU_SUPERSTEP")).strip().lower() not in (
+        "0", "off", "false", "no", "never")
+
+
+def superstep_window() -> int:
+    """Default window size K (``MXTPU_SUPERSTEP_WINDOW``)."""
+    return max(1, int(_cfg("MXTPU_SUPERSTEP_WINDOW")))
+
+
+def window_spec(batch_spec):
+    """The PartitionSpec of a stacked ``[K, ...]`` window given the
+    per-batch spec: the window axis is replicated (every chip walks all
+    K iterations), the batch axes keep their sharding."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(None, *tuple(batch_spec))
+
+
+def per_iteration_key(base_key, c0, i):
+    """The key loop iteration ``i`` must use inside a compiled
+    superstep: exactly what the ``i``-th of K successive
+    ``random.next_key()`` calls would draw given the counter stood at
+    ``c0`` (see ``random.reserve_keys``). THE one implementation of the
+    bit-exactness-critical derivation — both engines
+    (``SPMDTrainer.run_superstep``, gluon ``SuperStep``) call it, so the
+    RNG contract can never diverge between them."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.fold_in(
+        base_key, c0 + jnp.uint32(1) + i.astype(jnp.uint32))
+
+
+def slice_window(arrays, i):
+    """Batch ``i`` of a stacked window, sliced in-graph
+    (``dynamic_index_in_dim`` along the leading step axis)."""
+    from jax import lax
+
+    return [lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+            for a in arrays]
+
+
+def window_len(arrays: Sequence[Any]) -> int:
+    """K of a stacked window: the (common) leading dim of the leaves."""
+    ks = {int(a.shape[0]) for a in arrays if hasattr(a, "shape")}
+    if len(ks) != 1:
+        raise ValueError(
+            f"window leaves disagree on the leading (step) dim: "
+            f"{sorted(ks)} — stack K whole batches per leaf")
+    return ks.pop()
+
+
+def stack_window(batches: Sequence[Any]) -> List[np.ndarray]:
+    """Host-side stack of K same-shape batches into ``[K, ...]`` leaves
+    (one np array per batch position). For ``mxtpu.data`` pipelines
+    prefer ``Stage.window`` — it is resumable; this helper serves ad-hoc
+    feeds and tests."""
+    if not batches:
+        raise ValueError("empty window")
+    first = batches[0]
+    parts = first if isinstance(first, (tuple, list)) else (first,)
+    out = []
+    for j in range(len(parts)):
+        out.append(np.stack([
+            np.asarray(b[j] if isinstance(b, (tuple, list)) else b)
+            for b in batches]))
+    return out

@@ -84,6 +84,32 @@ def next_key():
     return jax.random.fold_in(_rs.key, _rs.counter)
 
 
+def reserve_keys(n: int):
+    """Advance the fold-in counter by ``n`` draws at once, returning
+    ``(root_key, counter_before)``. The i-th reserved key is
+    ``fold_in(root_key, counter_before + 1 + i)`` — exactly the key the
+    i-th of ``n`` successive :func:`next_key` calls would have drawn.
+
+    This is the superstep RNG contract (docs/TRAINING.md): a K-steps-per-
+    dispatch loop derives its per-iteration keys in-graph from
+    ``(root_key, counter_before)`` and the host advances the counter by K
+    here, so the loss stream (and every dropout mask) of one superstep is
+    bit-identical to K individual ``step()`` calls."""
+    base, c0 = _rs.key, _rs.counter
+    _rs.counter += int(n)
+    return base, c0
+
+
+def rollback_keys(counter_before: int) -> None:
+    """Undo a :func:`reserve_keys` after a dispatch that executed ZERO
+    steps (trace/compile failure, device OOM): restore the counter so a
+    supervised retry draws the identical key sequence — the bit-exact
+    retry contract (docs/RESILIENCE.md). Only valid when no draw
+    happened since the reservation; both superstep engines call it from
+    their dispatch exception paths."""
+    _rs.counter = int(counter_before)
+
+
 def current_key():
     return _rs.key
 

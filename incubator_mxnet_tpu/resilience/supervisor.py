@@ -132,6 +132,7 @@ class Supervisor:
                  classify: Callable[[BaseException], bool] = None,
                  seed: int = 0,
                  sleep: Callable[[float], None] = time.sleep,
+                 steps_per_call: Optional[int] = None,
                  site: str = "supervisor"):
         self.trainer = trainer
         self.manager = manager
@@ -155,6 +156,12 @@ class Supervisor:
         self.enforce_deadline = bool(enforce_deadline)
         self.classify = classify if classify is not None \
             else default_classify
+        # K when one supervised call executes a K-step superstep
+        # (docs/TRAINING.md): scales the hung-step deadline so a
+        # K-times-longer dispatch is not misread as a hang. None =
+        # read the trainer's nominal window (superstep_window attr,
+        # set by SPMDTrainer.superstep_feed), default 1.
+        self.steps_per_call = steps_per_call
         self.site = site
         self._sleep = sleep
         self._rng = _pyrandom.Random(seed)   # backoff jitter only
@@ -246,11 +253,25 @@ class Supervisor:
                     raise
                 feed_iter = self._restart(feed, exc)
                 continue
-            losses[self.step_num] = loss
-            self.step_num += 1
+            before = self.step_num
+            k = self._call_steps(loss)
+            if k == 1:
+                losses[self.step_num] = loss
+            else:
+                # a superstep returned its [k] per-step loss stream:
+                # the ledger stays per-step
+                import numpy as np
+
+                for j, v in enumerate(np.asarray(loss)):
+                    losses[self.step_num + j] = float(v)
+            self.step_num += k
             if self.manager is not None:
+                # checkpoint at the first step boundary on/after each
+                # cadence multiple — with a superstep advancing k steps
+                # per call, this is the enclosing superstep boundary
                 if self.checkpoint_every \
-                        and self.step_num % self.checkpoint_every == 0:
+                        and (self.step_num // self.checkpoint_every
+                             > before // self.checkpoint_every):
                     self._checkpoint(feed)
                 age = self.manager.age_seconds()
                 if age is not None:
@@ -262,6 +283,32 @@ class Supervisor:
                 for i in range(int(steps))]
 
     # -- pieces ---------------------------------------------------------------
+    @staticmethod
+    def _loss_count(loss) -> int:
+        """Elements in one call's loss: 1 for a scalar, k for a ``[k]``
+        superstep loss stream."""
+        shape = getattr(loss, "shape", None)
+        if shape:
+            return int(shape[0])
+        return 1
+
+    def _call_steps(self, loss) -> int:
+        """Steps one supervised call executed. Vector losses count as
+        supersteps ONLY when the trainer/caller advertises a window
+        (``steps_per_call``/``superstep_window``) — a custom step_fn
+        accidentally returning an unreduced per-sample loss must not be
+        silently booked as batch_size steps (it fails loudly at the
+        final float conversion, as before)."""
+        if self._steps_per_call() <= 1:
+            return 1
+        return self._loss_count(loss)
+
+    def _steps_per_call(self) -> int:
+        if self.steps_per_call is not None:
+            return max(1, int(self.steps_per_call))
+        return max(1, int(getattr(self.trainer, "superstep_window", 1)
+                          or 1))
+
     @staticmethod
     def _resumable(feed):
         """The feed rides the checkpoint only when it speaks the resume
@@ -333,17 +380,29 @@ class Supervisor:
                 self._note_retry("step", exc, attempt)
                 self._backoff(attempt)
 
-    def _deadline_s(self) -> Optional[float]:
-        meter = getattr(self.trainer, "_telemetry", None)
-        ema = getattr(meter, "ema_seconds", None)
+    def _deadline_s(self, k: int = 1) -> Optional[float]:
+        # every meter EMA here is PER-STEP (StepMeter amortizes a
+        # superstep's wall time over its count), so the deadline for one
+        # supervised CALL scales by the k steps it executes — a 20x
+        # longer superstep dispatch is 20x the work, not a hang
+        meters = ("_superstep_telemetry", "_telemetry") if k > 1 \
+            else ("_telemetry", "_superstep_telemetry")
+        ema = None
+        for attr in meters:
+            ema = getattr(getattr(self.trainer, attr, None),
+                          "ema_seconds", None)
+            if ema is not None:
+                break
         if ema is None:
             ema = self._ema_s
         if ema is None:
             return None                    # no evidence yet: disarmed
-        return max(self.min_deadline_s, self.watchdog_multiplier * ema)
+        return max(self.min_deadline_s,
+                   self.watchdog_multiplier * ema * max(1, k))
 
     def _with_deadline(self, args) -> float:
-        deadline = self._deadline_s()
+        k = self._steps_per_call()
+        deadline = self._deadline_s(k)
         use_alarm = (self.enforce_deadline and deadline is not None
                      and hasattr(signal, "SIGALRM")
                      and threading.current_thread()
@@ -379,8 +438,12 @@ class Supervisor:
             self._emit({"event": "hung_step", "step": self.step_num,
                         "deadline_s": round(deadline, 3),
                         "wall_s": round(dt, 3)})
-        self._ema_s = dt if self._ema_s is None \
-            else 0.7 * self._ema_s + 0.3 * dt
+        # fallback EMA stays per-STEP: amortize the call's wall time
+        # over the steps it actually executed (a tail superstep runs
+        # fewer than the nominal k)
+        per = dt / max(1, self._call_steps(loss))
+        self._ema_s = per if self._ema_s is None \
+            else 0.7 * self._ema_s + 0.3 * per
         return loss
 
     def _backoff(self, attempt: int) -> None:

@@ -268,7 +268,10 @@ class StepMeter:
         compiled = (compiles0 is not None and wd is not None
                     and wd.site_compiles(self.site) != compiles0)
         insts["steps"].inc(scope.count)
-        insts["seconds"].observe(per)
+        # one superstep = count per-step observations of the amortized
+        # per-step time: percentiles stay step-weighted, so a K=32 run
+        # compares apples-to-apples with a per-dispatch run
+        insts["seconds"].observe(per, n=scope.count)
         insts["dispatches"].inc(scope.dispatches)
         if scope.h2d_bytes:
             insts["h2d"].inc(scope.h2d_bytes)

@@ -182,6 +182,116 @@ def test_device_prefetcher_next_after_epoch_raises_not_hangs():
     feed.close()
 
 
+def test_window_stage_stacks_and_short_tail():
+    """K doesn't divide the epoch: 5 batches at window 2 -> 2,2,1 — the
+    tail is a SHORT window (short tail superstep), never dropped."""
+    x, y = _xy(20, 3)
+    pipe = data.from_ndarray(x, y).batch(4).window(2)   # 5 batches
+    wins = list(pipe)
+    assert [w[0].shape[0] for w in wins] == [2, 2, 1]
+    np.testing.assert_array_equal(wins[0][0][0], x[:4])
+    np.testing.assert_array_equal(wins[0][0][1], x[4:8])
+    np.testing.assert_array_equal(wins[2][1][0], y[16:])
+    # next epoch re-windows identically
+    assert [w[0].shape[0] for w in list(pipe)] == [2, 2, 1]
+    pipe.close()
+
+
+def test_window_partial_final_batch_leads_own_tail_window():
+    """A partial final batch can't np.stack with full ones: it must
+    lead its own tail window, with no sample lost."""
+    x, y = _xy(10, 2)
+    pipe = data.from_ndarray(x, y).batch(4).window(4)   # batches 4,4,2
+    wins = list(pipe)
+    assert [w[0].shape[0] for w in wins] == [2, 1]
+    assert wins[0][0].shape == (2, 4, 2)
+    assert wins[1][0].shape == (1, 2, 2)
+    total = sum(w[0].shape[0] * w[0].shape[1] for w in wins)
+    assert total == 10
+    pipe.close()
+
+
+def test_window_resume_bit_exact_through_shuffle():
+    """Mid-epoch state_dict on a windowed shuffle+shard+prefetch chain
+    restores a bit-identical remaining window stream (superstep
+    checkpoints sit on window boundaries)."""
+    def build():
+        return _resume_pipe().window(2)
+
+    pipe = build()
+    it = iter(pipe)
+    next(it)
+    sd = pipe.state_dict()
+    rest_a = list(it)
+
+    pipe2 = build()
+    pipe2.load_state_dict(sd)
+    rest_b = list(iter(pipe2))
+    _assert_streams_equal(rest_a, rest_b)
+    pipe.close()
+    pipe2.close()
+
+
+def test_window_resume_after_short_held_window_drops_nothing():
+    """Regression (PR 8 review): a checkpoint taken right after a SHORT
+    window (a partial final batch held back mid-window) must restore to
+    the held batch, not stride past it — the window records its exact
+    upstream consumption, so the pending tail window survives resume."""
+    x, y = _xy(10, 2)
+
+    def build():
+        return data.from_ndarray(x, y).batch(4).window(4)  # 4,4,2 batches
+
+    pipe = build()
+    it = iter(pipe)
+    w1 = next(it)                            # short window [b1, b2]
+    assert w1[0].shape[0] == 2
+    sd = pipe.state_dict()
+    rest_a = list(it)                        # the held tail window [b3]
+    assert len(rest_a) == 1 and rest_a[0][0].shape == (1, 2, 2)
+
+    pipe2 = build()
+    pipe2.load_state_dict(sd)
+    rest_b = list(iter(pipe2))
+    _assert_streams_equal(rest_a, rest_b)    # b3's samples NOT dropped
+    pipe.close()
+    pipe2.close()
+
+
+def test_device_prefetcher_counts_short_tail_windows_exactly():
+    """Regression (PR 8 review): a 5-batch epoch through window(2)
+    delivers windows of 2,2,1 — the batch counter and the JSONL
+    batches_exact must say 5, not the nominal 3*2=6."""
+    x, y = _xy(20, 3)
+    pipe = data.from_ndarray(x, y).batch(4).window(2)
+    feed = data.DevicePrefetcher(pipe, depth=2, site="t.exact",
+                                 steps_per_item=2)
+    insts = feed._instruments()
+    before = insts["batches"].value
+    assert len(list(feed)) == 3
+    assert insts["batches"].value - before == 5
+    assert feed._batches_exact == 5
+    feed.close()
+
+
+def test_device_prefetcher_windowed_tail_no_hang():
+    """ISSUE 9 satellite: the DevicePrefetcher over a windowed pipeline
+    must deliver the end-of-epoch partial window (fewer than K batches
+    left) instead of dropping samples or hanging, and keep raising
+    StopIteration after the epoch."""
+    x, y = _xy(20, 3)
+    pipe = data.from_ndarray(x, y).batch(4).window(2)
+    feed = data.DevicePrefetcher(pipe, depth=2, site="t.window",
+                                 steps_per_item=2)
+    wins = list(feed)
+    assert [int(np.asarray(w[0]).shape[0]) for w in wins] == [2, 2, 1]
+    with pytest.raises(StopIteration):
+        next(feed)
+    # next epoch restarts cleanly
+    assert len(list(feed)) == 3
+    feed.close()
+
+
 def test_recordio_shard_terminates_at_epoch_end(tmp_path):
     """Regression: a shard stride hitting EOF is end-of-epoch, not a
     ValueError (10 records, 4 shards -> strides overrun the tail)."""

@@ -1,0 +1,575 @@
+"""Superstep engine tests (ISSUE 9): K-steps-per-dispatch parity —
+loss stream, dropout draws and params bit-exact vs K individual step()
+calls; tail windows when K doesn't divide the epoch; the MXTPU_SUPERSTEP
+knob's transparent fallback; O(1)-dispatch telemetry; Supervisor
+superstep-boundary checkpointing with bit-exact chaos/preemption resume;
+the gluon SuperStep engine (fused vs eager parity, fallback taxonomy);
+and telemetry_report's superstep normalization."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import data as mxdata
+from incubator_mxnet_tpu import gluon, parallel, resilience
+from incubator_mxnet_tpu.config import config
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel.superstep import stack_window
+from incubator_mxnet_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    chaos.disable()
+    config.unset("MXTPU_SUPERSTEP")
+
+
+def _spmd_trainer(seed=0, dropout=False):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"))
+    if dropout:
+        net.add(nn.Dropout(0.3))
+    net.add(nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    return parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}))
+
+
+def _batches(n, seed=3, batch=16, dim=8, classes=4):
+    rs = np.random.RandomState(seed)
+    return [(rs.rand(batch, dim).astype(np.float32),
+             rs.randint(0, classes, (batch,)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _pipe(n=64, batch=8, seed=5):
+    x = np.random.RandomState(1).rand(n, 8).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 4, (n,)).astype(np.float32)
+    return (mxdata.from_ndarray(x, y).shuffle(16, seed=seed)
+            .shard(0, 1).batch(batch).prefetch(2))
+
+
+def _ref_run(steps, trainer_seed=0, pipe_seed=5, rng_seed=42):
+    """Uninterrupted per-step reference loss stream over the pipe."""
+    mx.random.seed(rng_seed)
+    tr = _spmd_trainer(trainer_seed)
+    pipe = _pipe(seed=pipe_seed)
+    losses, it = [], iter(pipe)
+    for _ in range(steps):
+        try:
+            b = next(it)
+        except StopIteration:
+            it = iter(pipe)
+            b = next(it)
+        losses.append(float(tr.step(*b)))
+    pipe.close()
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# SPMD run_superstep: the bit-exactness contract
+# ---------------------------------------------------------------------------
+def test_run_superstep_bit_exact_vs_step_calls_with_dropout():
+    """ISSUE 9 parity satellite: one K-superstep == K step() calls,
+    bit-exact on CPU INCLUDING the fold_in-derived per-iteration RNG
+    (the dropout masks must align across the superstep boundary)."""
+    K = 5
+    batches = _batches(K)
+
+    ta = _spmd_trainer(dropout=True)
+    mx.random.seed(42)
+    ref = [float(ta.step(x, y)) for x, y in batches]
+
+    tb = _spmd_trainer(dropout=True)
+    mx.random.seed(42)
+    win = stack_window(batches)
+    got = np.asarray(tb.run_superstep(win[0], win[1]))
+    np.testing.assert_array_equal(np.asarray(ref, np.float32),
+                                  got.astype(np.float32))
+    for n in ta.params:
+        np.testing.assert_array_equal(np.asarray(ta.params[n]),
+                                      np.asarray(tb.params[n]))
+
+
+def test_run_superstep_rng_counter_advances_like_k_steps():
+    """RNG draws AFTER a superstep must continue where K step() calls
+    would have left the global counter (draw alignment across the
+    superstep boundary)."""
+    K = 3
+    batches = _batches(K, seed=9)
+    ta = _spmd_trainer()
+    mx.random.seed(7)
+    for x, y in batches:
+        ta.step(x, y)
+    after_steps = mx.nd.uniform(shape=(4,)).asnumpy()
+
+    tb = _spmd_trainer()
+    mx.random.seed(7)
+    win = stack_window(batches)
+    tb.run_superstep(win[0], win[1])
+    after_super = mx.nd.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(after_steps, after_super)
+
+
+def test_superstep_feed_tail_window_bit_exact():
+    """K=4 over a 8-batch epoch pulled for 10 steps: windows 4,4 then
+    epoch 2 starts — and with drop_last=False a short epoch tail runs a
+    SHORT superstep; the whole stream matches per-step training."""
+    steps = 16
+    ref = _ref_run(steps)
+    mx.random.seed(42)
+    tr = _spmd_trainer()
+    pipe = _pipe()
+    feed = tr.superstep_feed(pipe, window=3)   # 3 does not divide 8
+    losses = []
+    while len(losses) < steps:
+        for win in feed:
+            losses.extend(float(v) for v in np.asarray(
+                tr.run_superstep(*win)))
+            if len(losses) >= steps:
+                break
+    feed.close()
+    assert losses[:steps] == ref
+
+
+def test_superstep_knob_off_falls_back_same_stream():
+    K = 4
+    batches = _batches(K, seed=11)
+    win = stack_window(batches)
+
+    ta = _spmd_trainer()
+    mx.random.seed(5)
+    fused = np.asarray(ta.run_superstep(win[0], win[1]))
+    assert any(isinstance(k, tuple) and k and k[0] == "superstep"
+               for k in ta._step_cache)
+
+    config.set("MXTPU_SUPERSTEP", "0")
+    tb = _spmd_trainer()
+    mx.random.seed(5)
+    eager = np.asarray(tb.run_superstep(win[0], win[1]))
+    assert not any(isinstance(k, tuple) and k and k[0] == "superstep"
+                   for k in tb._step_cache)
+    np.testing.assert_array_equal(fused, eager)
+
+
+def test_superstep_o1_dispatch_telemetry():
+    """The dispatch meter must show ONE dispatch per K steps, per-step
+    histogram weighting, and fused_steps on the JSONL record."""
+    from incubator_mxnet_tpu import telemetry
+
+    K = 4
+    tr = _spmd_trainer(seed=2)
+    win = stack_window(_batches(K, seed=13))
+    tr.run_superstep(win[0], win[1])
+    tr.run_superstep(win[0], win[1])
+    insts = tr._superstep_telemetry._insts
+    assert insts is not None
+    d0, s0 = insts["dispatches"].value, insts["steps"].value
+    tr.run_superstep(win[0], win[1])
+    assert insts["dispatches"].value - d0 == 1
+    assert insts["steps"].value - s0 == K
+    # histogram counts per-step observations, not per-dispatch
+    assert insts["seconds"].count >= 3 * K
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: superstep boundaries, chaos restore, preemption (SIGTERM)
+# ---------------------------------------------------------------------------
+def test_supervisor_superstep_run_matches_reference():
+    steps = 16
+    ref = _ref_run(steps)
+    mx.random.seed(42)
+    tr = _spmd_trainer()
+    pipe = _pipe()
+    feed = tr.superstep_feed(pipe, window=4)
+    sup = resilience.Supervisor(tr, None, step_fn=tr.run_superstep,
+                                backoff_base_s=0.001)
+    losses = sup.run(feed, steps=steps)
+    feed.close()
+    assert losses == ref
+    assert sup.step_num == steps
+
+
+def test_supervisor_superstep_restart_is_bit_exact(tmp_path):
+    """Fatal chaos mid-run with K>1: restore from the superstep-boundary
+    checkpoint and the merged loss ledger equals the uninterrupted run's
+    bit-exactly — the sidecar's K-batch position advance and the
+    superstep-boundary accounting are both right."""
+    steps, K = 16, 4
+    ref = _ref_run(steps)
+    mx.random.seed(42)
+    tr = _spmd_trainer()
+    pipe = _pipe()
+    feed = tr.superstep_feed(pipe, window=K)
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, step_fn=tr.run_superstep,
+                                checkpoint_every=K, backoff_base_s=0.001)
+    chaos.configure({"step": {"at_calls": [3], "transient": False}})
+    losses = sup.run(feed, steps=steps, start_step=0)
+    chaos.disable()
+    feed.close()
+    assert sup.restarts == 1
+    assert losses == ref
+
+
+def test_supervisor_superstep_retry_is_bit_exact():
+    """A transient fault at superstep entry retries the IDENTICAL
+    window (chaos fires before the RNG counter reservation)."""
+    steps = 12
+    ref = _ref_run(steps)
+    mx.random.seed(42)
+    tr = _spmd_trainer()
+    pipe = _pipe()
+    feed = tr.superstep_feed(pipe, window=4)
+    sup = resilience.Supervisor(tr, None, step_fn=tr.run_superstep,
+                                backoff_base_s=0.001)
+    chaos.configure({"step": {"at_calls": [2], "transient": True}})
+    losses = sup.run(feed, steps=steps)
+    chaos.disable()
+    feed.close()
+    assert sup.retries == 1
+    assert losses == ref
+
+
+def test_supervisor_superstep_sigterm_preempt_resume_bit_exact(tmp_path):
+    """ISSUE 9 resume satellite: SIGTERM mid-run with K>1 checkpoints at
+    the next superstep boundary; a fresh process restores and the merged
+    ledger is bit-exact vs uninterrupted."""
+    steps, K = 16, 4
+    ref = _ref_run(steps)
+    mx.random.seed(42)
+    tr = _spmd_trainer()
+    pipe = _pipe()
+    feed = tr.superstep_feed(pipe, window=K)
+    mgr = resilience.CheckpointManager(str(tmp_path))
+    sup = resilience.Supervisor(tr, mgr, step_fn=tr.run_superstep,
+                                checkpoint_every=8)
+    sup.install_preemption_handler()
+    orig = tr.run_superstep
+
+    def stepper(*args):
+        if sup.step_num == 8:          # the SIGTERM preemption notice
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig(*args)
+
+    sup._step_fn = stepper
+    try:
+        with pytest.raises(resilience.Preempted) as ei:
+            sup.run(feed, steps=steps)
+    finally:
+        sup.uninstall_preemption_handler()
+        feed.close()
+    assert ei.value.step == 12         # the in-flight superstep finished
+    assert mgr.newest_valid() == 12    # final sync checkpoint, K-aligned
+
+    mx.random.seed(777)                # resume must not depend on this
+    tr2 = _spmd_trainer(seed=31)
+    pipe2 = _pipe()
+    feed2 = tr2.superstep_feed(pipe2, window=K)
+    mgr2 = resilience.CheckpointManager(str(tmp_path))
+    sup2 = resilience.Supervisor(tr2, mgr2, step_fn=tr2.run_superstep)
+    losses = sup2.run(feed2, steps=steps)
+    feed2.close()
+    assert all(np.isnan(v) for v in losses[:12])
+    assert losses[12:] == ref[12:]
+
+
+def test_supervisor_deadline_scales_with_window():
+    tr = _spmd_trainer(seed=4)
+    tr.superstep_window = 8
+    sup = resilience.Supervisor(tr, None, watchdog_multiplier=10.0,
+                                min_deadline_s=0.0)
+    tr._superstep_telemetry._ema_s = 0.05    # per-step EMA
+    assert sup._deadline_s(8) == pytest.approx(10.0 * 0.05 * 8)
+    assert sup._steps_per_call() == 8
+
+
+def test_run_superstep_advertises_window_for_hand_stacked_feeds():
+    """Regression (PR 8 review): driving run_superstep with self-stacked
+    windows (no superstep_feed) must still scale the Supervisor's
+    deadline — the trainer advertises the window itself."""
+    tr = _spmd_trainer(seed=6)
+    assert tr.superstep_window == 1
+    win = stack_window(_batches(4, seed=21))
+    tr.run_superstep(win[0], win[1])
+    assert tr.superstep_window == 4
+    sup = resilience.Supervisor(tr, None)
+    assert sup._steps_per_call() == 4
+
+
+def test_run_superstep_dispatch_failure_rolls_back_rng():
+    """Regression (PR 8 review): a dispatch that executes ZERO steps
+    (compile failure, OOM) must not burn the K reserved RNG draws — a
+    supervised retry replays the identical window."""
+    from incubator_mxnet_tpu import random as _rnd
+
+    K = 3
+    batches = _batches(K, seed=23)
+    win = stack_window(batches)
+
+    warm = stack_window(_batches(K, seed=99))
+
+    ref_tr = _spmd_trainer()
+    mx.random.seed(13)
+    ref_tr.run_superstep(warm[0], warm[1])
+    mx.random.seed(13)
+    ref = np.asarray(ref_tr.run_superstep(win[0], win[1]))
+
+    tr = _spmd_trainer()
+    mx.random.seed(13)
+    tr.run_superstep(warm[0], warm[1])       # populate the loop cache
+    mx.random.seed(13)                       # rewind to the ref point
+    key = next(c for c in tr._step_cache
+               if isinstance(c, tuple) and c and c[0] == "superstep")
+    real = tr._step_cache[key]
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("dispatch failed")
+
+    tr._step_cache[key] = boom
+    steps_before = tr._num_steps
+    c_before = _rnd._rs.counter
+    with pytest.raises(RuntimeError):
+        tr.run_superstep(win[0], win[1])
+    assert _rnd._rs.counter == c_before      # reservation rolled back
+    assert tr._num_steps == steps_before
+    tr._step_cache[key] = real
+    got = np.asarray(tr.run_superstep(win[0], win[1]))   # the retry
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_gluon_superstep_dispatch_failure_rolls_back_counts():
+    """Regression (PR 8 review): a failed gluon superstep dispatch must
+    not advance update counts / num_update / the RNG counter (the
+    FusedStep no-mutation-before-commit contract)."""
+    from incubator_mxnet_tpu import random as _rnd
+
+    build = _gluon_pair()
+    net, tr = build()
+    eng = tr.superstep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                       window=2)
+    win = stack_window(_batches(2, seed=31))
+    eng.run_window(win[0], win[1])           # engage + warm the cache
+    assert eng.dispatch_count == 1
+    counts_before = dict(tr._optimizer._index_update_count)
+    num_before = tr._optimizer.num_update
+    c_before = _rnd._rs.counter
+    key = next(iter(eng._cache))
+    real = eng._cache[key]
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("dispatch failed")
+
+    eng._cache[key] = boom
+    with pytest.raises(RuntimeError):
+        eng.run_window(win[0], win[1])
+    assert dict(tr._optimizer._index_update_count) == counts_before
+    assert tr._optimizer.num_update == num_before
+    assert _rnd._rs.counter == c_before
+    eng._cache[key] = real
+    losses = eng.run_window(win[0], win[1])  # the retry succeeds
+    assert np.asarray(losses).shape == (2,)
+
+
+def test_reshard_windowed_chain_short_tail_position_exact():
+    """Regression (PR 8 review): cross-topology sidecar reshard must use
+    the window stage's recorded EXACT consumption — a short tail window
+    must not overcount the global sample position (silent sample skip)."""
+    x = np.arange(128, dtype=np.float32)
+
+    def pipe(rank, count, k):
+        return mxdata.from_ndarray(x).shard(rank, count).batch(2).window(k)
+
+    # each of 2 ranks: 32 batches -> window(3) = 10 full + short tail of
+    # 2; consuming the whole epoch records cursor=11, consumed=32 —
+    # nominal cursor*6 would claim 66 samples/rank, actual is 64
+    states = []
+    for r in range(2):
+        p = pipe(r, 2, 3)
+        for _ in iter(p):
+            pass
+        states.append(p.state_dict())
+        p.close()
+    # reshard to ONE rank at window(4): global position 128 = the whole
+    # epoch, which sits on the new topology's window boundary (128/2/4)
+    p1 = pipe(0, 1, 4)
+    mxdata.reshard_iterator_state(states, p1)
+    assert list(iter(p1)) == []              # epoch exactly consumed
+    nxt = next(iter(p1))                     # epoch 2 starts at sample 0
+    assert float(np.asarray(nxt)[0, 0]) == 0.0
+    p1.close()
+
+
+def test_reshard_windowed_chain_refuses_ambiguous_short_window():
+    """A rewound cursor below the snapshot AFTER short windows were
+    produced cannot be placed exactly — must refuse, never silently
+    skip samples."""
+    sd = {"kind": "window", "epoch": 0, "cursor": 2, "window_size": 3,
+          "consumed": 8, "cursor_snap": 3,
+          "source": {"kind": "batch", "epoch": 0, "cursor": 0,
+                     "batch_size": 2,
+                     "source": {"kind": "from_ndarray", "epoch": 0,
+                                "cursor": 0}}}
+    x = np.arange(64, dtype=np.float32)
+    p = mxdata.from_ndarray(x).batch(2).window(3)
+    with pytest.raises(ValueError, match="short window"):
+        mxdata.reshard_iterator_state([sd], p)
+    p.close()
+
+
+def test_supervisor_vector_loss_not_superstep_without_window():
+    """Regression (PR 8 review): a custom step_fn returning an
+    unreduced per-sample loss vector must NOT be booked as batch_size
+    steps when no superstep window is advertised."""
+    tr = _spmd_trainer(seed=5)
+    sup = resilience.Supervisor(tr, None)
+    vec = np.zeros((256,), np.float32)
+    assert sup._call_steps(vec) == 1          # no window advertised
+    tr.superstep_window = 4
+    assert sup._call_steps(vec[:4]) == 4      # superstep mode: [k] = k
+
+
+# ---------------------------------------------------------------------------
+# gluon SuperStep engine
+# ---------------------------------------------------------------------------
+def _gluon_pair(seed=1, optimizer="adam", kwargs=None):
+    def build():
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=16))
+        net.initialize(init="xavier")
+        net(mx.nd.uniform(shape=(4, 8)))
+        tr = gluon.Trainer(net.collect_params(), optimizer,
+                           dict(kwargs or {"learning_rate": 0.05}))
+        return net, tr
+
+    return build
+
+
+@pytest.mark.parametrize("opt,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+])
+def test_gluon_superstep_fused_matches_eager(opt, kwargs):
+    """Fused K-loop (forward+backward+update_fn in one executable, t
+    per-iteration in-graph) vs the transparent eager fallback: identical
+    per-step loss stream and weights over TWO windows."""
+    build = _gluon_pair(optimizer=opt, kwargs=kwargs)
+    K = 4
+    wins = [stack_window(_batches(K, seed=s)) for s in (3, 17)]
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net_f, tr_f = build()
+    eng_f = tr_f.superstep(net_f, loss_fn, window=K)
+    mx.random.seed(99)
+    lf = np.concatenate([np.asarray(eng_f.run_window(w[0], w[1]))
+                         for w in wins])
+    assert eng_f.dispatch_count == 2, eng_f.last_fallback
+
+    config.set("MXTPU_SUPERSTEP", "0")
+    net_e, tr_e = build()
+    eng_e = tr_e.superstep(net_e, loss_fn, window=K)
+    mx.random.seed(99)
+    le = np.concatenate([np.asarray(eng_e.run_window(w[0], w[1]))
+                         for w in wins])
+    config.unset("MXTPU_SUPERSTEP")
+    assert eng_e.dispatch_count == 0
+    assert eng_e.last_fallback == "MXTPU_SUPERSTEP off"
+    np.testing.assert_allclose(lf, le, rtol=1e-6, atol=1e-7)
+    pf = net_f._collect_params_with_prefix()
+    pe = net_e._collect_params_with_prefix()
+    for n in pf:
+        np.testing.assert_allclose(np.asarray(pf[n].data()._data),
+                                   np.asarray(pe[n].data()._data),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_superstep_fallback_reasons():
+    build = _gluon_pair()
+    net, tr = build()
+    eng = tr.superstep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                       window=2)
+    # amp loss scaling pins the eager path (PR 2 fallback taxonomy)
+    tr._amp_loss_scaler = object()
+    win = stack_window(_batches(2, seed=5))
+    losses = eng.run_window(win[0], win[1])
+    assert eng.dispatch_count == 0
+    assert eng.last_fallback == "amp loss scaling"
+    assert np.asarray(losses).shape == (2,)
+    del tr._amp_loss_scaler
+    eng.run_window(win[0], win[1])
+    assert eng.dispatch_count == 1
+    assert eng.last_fallback is None   # stale reason cleared on engage
+
+
+def test_gluon_superstep_feed_windows():
+    build = _gluon_pair()
+    net, tr = build()
+    eng = tr.superstep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                       window=2)
+    x = np.random.RandomState(0).rand(12, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (12,)).astype(np.float32)
+    pipe = mxdata.from_ndarray(x, y).batch(4)     # 3 batches -> 2,1
+    feed = eng.feed(pipe)
+    ks = []
+    for win in feed:
+        ks.append(int(np.asarray(win[0]).shape[0]))
+        eng.run_window(win[0], win[1])
+    feed.close()
+    assert ks == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report superstep normalization
+# ---------------------------------------------------------------------------
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_report_normalizes_superstep_percentiles(tmp_path):
+    """A K=8 run whose records carry fused_steps must report per-step
+    percentiles and dispatches/step — apples-to-apples vs a
+    pre-superstep run of the same per-step speed."""
+    import tools.telemetry_report as rep
+
+    a = str(tmp_path / "per_step.jsonl")
+    b = str(tmp_path / "superstep.jsonl")
+    _write_jsonl(a, [{"kind": "step", "site": "spmd.step", "step": i + 1,
+                      "wall_ms": 2.0, "dispatches": 1}
+                     for i in range(16)])
+    _write_jsonl(b, [{"kind": "step", "site": "spmd.step",
+                      "step": 8 * (i + 1), "wall_ms": 2.0,
+                      "dispatches": 1, "fused_steps": 8}
+                     for i in range(2)])
+    ma = rep._comparable_metrics(rep._read(a))
+    mb = rep._comparable_metrics(rep._read(b))
+    assert ma["step/spmd.step/p50_ms"] == mb["step/spmd.step/p50_ms"]
+    assert ma["step/spmd.step/dispatches_per_step"] == 1.0
+    assert mb["step/spmd.step/dispatches_per_step"] == pytest.approx(1 / 8)
+    out = rep.summarize(b)
+    assert "16" in out          # 2 records = 16 steps
+    assert "disp/step" in out
+
+
+def test_report_scales_data_batches_by_superstep(tmp_path):
+    import tools.telemetry_report as rep
+
+    p = str(tmp_path / "data.jsonl")
+    _write_jsonl(p, [{"kind": "data", "site": "spmd.superstep.data",
+                      "batches": 5, "superstep": 8, "queue_depth": 1,
+                      "input_bound_pct": 3.0}])
+    out = rep.summarize(p)
+    assert "40" in out          # 5 windows * K=8 batches

@@ -82,6 +82,26 @@ def _group_steps(records: List[Dict]) -> Dict[str, List[Dict]]:
     return sites
 
 
+def _step_walls(steps: List[Dict]) -> List[float]:
+    """Per-STEP wall samples for percentile math. A superstep record
+    (``fused_steps: k``) already carries the per-step amortized
+    ``wall_ms`` but stands for k steps — weight it k times so the
+    percentiles of a K=32 run compare apples-to-apples against a
+    pre-superstep per-dispatch run. Compile-dominated steps stay
+    excluded (the meter keeps them out of EMA/MFU for the same
+    reason)."""
+    walls: List[float] = []
+    for r in steps:
+        if "wall_ms" in r and not r.get("compiled"):
+            walls.extend([r["wall_ms"]]
+                         * max(1, int(r.get("fused_steps", 1))))
+    return walls
+
+
+def _steps_of(records: List[Dict]) -> int:
+    return sum(max(1, int(r.get("fused_steps", 1))) for r in records)
+
+
 def _mfu_trend(steps: List[Dict]) -> Optional[str]:
     mfus = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
     if not mfus:
@@ -108,18 +128,20 @@ def summarize(path: str, merge: bool = False) -> str:
     if sites:
         lines.append("")
         lines.append(f"{'site':24s} {'steps':>7s} {'p50 ms':>9s} "
-                     f"{'p95 ms':>9s} {'MFU trend':>16s} {'recompiles':>11s}")
+                     f"{'p95 ms':>9s} {'disp/step':>10s} "
+                     f"{'MFU trend':>16s} {'recompiles':>11s}")
         for site in sorted(sites):
             steps = sites[site]
-            # compile-dominated steps carry "compiled": true for exactly
-            # this exclusion (the meter keeps them out of EMA/MFU too) —
-            # else a cold run's p95 is its compile time, not step time
-            walls = [r["wall_ms"] for r in steps
-                     if "wall_ms" in r and not r.get("compiled")]
+            # per-step, superstep-normalized, compile-excluded samples
+            walls = _step_walls(steps)
+            n_steps = _steps_of(steps)
+            disp = sum(int(r.get("dispatches", 1)) for r in steps) \
+                / max(1, n_steps)
             trend = _mfu_trend(steps) or "-"
             lines.append(
-                f"{site:24s} {sum(r.get('fused_steps', 1) for r in steps):7d} "
+                f"{site:24s} {n_steps:7d} "
                 f"{_pctl(walls, 50):9.3f} {_pctl(walls, 95):9.3f} "
+                f"{disp:10.3f} "
                 f"{trend:>16s} {recompiles.get(site, 0):11d}")
     for site, n in sorted(recompiles.items()):
         if site not in sites:
@@ -148,8 +170,18 @@ def summarize(path: str, merge: bool = False) -> str:
             recs = data[site]
             bounds = [r["input_bound_pct"] for r in recs
                       if "input_bound_pct" in r]
+            # superstep feeds deliver stacked windows: 'batches' counts
+            # items delivered; 'batches_exact' (tail windows counted by
+            # their actual length) or the nominal 'superstep' factor
+            # converts to the per-batch granularity pre-superstep runs
+            # report
+            n_batches = max(
+                int(r.get("batches_exact",
+                          int(r.get("batches", 0))
+                          * int(r.get("superstep", 1))))
+                for r in recs)
             lines.append(
-                f"{site:24s} {max(r.get('batches', 0) for r in recs):8d} "
+                f"{site:24s} {n_batches:8d} "
                 f"{(f'{bounds[-1]:.1f}' if bounds else '-'):>13s} "
                 f"{sum(1 for r in recs if r.get('epoch_end')):7d}")
     res = [r for r in records if r.get("kind") == "resilience"]
@@ -197,11 +229,17 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
             if isinstance(r.get("mfu_pct"), (int, float)):
                 out[f"bench/{r['metric']}/mfu_pct"] = float(r["mfu_pct"])
     for site, steps in _group_steps(records).items():
-        walls = [r["wall_ms"] for r in steps
-                 if "wall_ms" in r and not r.get("compiled")]
+        # superstep-normalized per-step samples (see _step_walls): a
+        # --compare of a K>1 run against a pre-superstep run diffs
+        # per-step percentiles, not per-dispatch ones
+        walls = _step_walls(steps)
         if walls:
             out[f"step/{site}/p50_ms"] = _pctl(walls, 50)
             out[f"step/{site}/p95_ms"] = _pctl(walls, 95)
+        n_steps = _steps_of(steps)
+        if n_steps:
+            out[f"step/{site}/dispatches_per_step"] = \
+                sum(int(r.get("dispatches", 1)) for r in steps) / n_steps
         mfus = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
         if mfus:
             out[f"step/{site}/mfu_pct"] = mfus[-1]
