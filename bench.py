@@ -54,6 +54,11 @@ ANCHORS = {
     # geomean over the MLP/LSTM shapes of per_step(K=1)/per_step(K=32);
     # anchor 1.0 = dispatch cost not amortized, so vs_baseline IS the win
     "superstep": 1.0,
+    # ZeRO-3 per-chip param+opt memory reduction vs the replicated
+    # baseline (benchmark/zero_bench.py, geomean over the MLP/BERT
+    # shapes on the 8-device mesh); anchor 1.0 = no sharding, so
+    # vs_baseline IS the reduction (ISSUE 10 acceptance: >= 4x)
+    "zero": 1.0,
     "resnet50": 800.0,
 }
 
@@ -575,6 +580,29 @@ def bench_resilience():
             "resilience_async_ckpt_overhead_pct", "resilience", None)
 
 
+def _arrange_virtual_mesh(n: int = 8) -> None:
+    """Self-arrange an n-device virtual CPU mesh for bench rows that
+    need devices to shard BETWEEN (reshard, zero): no-op if jax is
+    already imported (each config runs in its own subprocess, so a
+    first-in-process row gets the flags in before backend init — the
+    tests/conftest.py strategy)."""
+    import os
+    import sys
+
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
 def bench_reshard():
     """config[7]: topology-portable restore — planned-slice reshard vs
     the full-gather rebuild restoring a ZeRO-sharded checkpoint onto a
@@ -593,19 +621,7 @@ def bench_reshard():
     import os
     import sys
 
-    if "jax" not in sys.modules:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-
+    _arrange_virtual_mesh()
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from benchmark.reshard_bench import compare_restore
 
@@ -623,6 +639,39 @@ def bench_reshard():
                                         "restore_devices")}})
     return (out["peak_reduction_x"], "x_peak_host_bytes_reduction",
             "reshard_peak_host_reduction", "reshard", None)
+
+
+def bench_zero():
+    """config[9]: ZeRO ladder memory/wire table — stage {0,1,2,3} x
+    quant {none,int8,2bit} sweep on the 8-device virtual CPU mesh
+    (benchmark/zero_bench.py). The recorded value is the geomean over
+    the MLP/BERT shapes of the ZeRO-3 per-chip param+opt bytes
+    reduction vs the replicated baseline; anchor 1.0, so
+    ``vs_baseline`` IS the reduction. Per-cell rows (measured per-chip
+    param/grad/opt/residual bytes, schedule-exact bytes-on-wire per
+    step, quantized-RS fraction, loss delta vs baseline) ride the JSONL
+    mirror — the docs/SCALING.md ZeRO table is regenerated from them.
+    No MFU row — the metric is memory and wire, not chip FLOPs."""
+    import os
+    import sys
+
+    _arrange_virtual_mesh()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.zero_bench import (memory_reduction, rs_wire_reduction,
+                                      sweep)
+
+    rows_by_model = sweep()
+    val = memory_reduction(rows_by_model)
+    if val <= 0:
+        raise RuntimeError("zero sweep produced no memory numbers")
+    _jsonl_emit({"kind": "bench", "metric": "zero_summary",
+                 "memory_reduction_x": val,
+                 "int8_rs_wire_reduction_x":
+                     rs_wire_reduction(rows_by_model, "int8"),
+                 "2bit_rs_wire_reduction_x":
+                     rs_wire_reduction(rows_by_model, "2bit")})
+    return (val, "x_param_opt_bytes_per_chip_reduction",
+            "zero3_memory_reduction", "zero", None)
 
 
 def bench_superstep():
@@ -657,6 +706,7 @@ CONFIGS = {
     "resilience": bench_resilience,
     "reshard": bench_reshard,
     "superstep": bench_superstep,
+    "zero": bench_zero,
     "resnet50": bench_resnet,  # headline — always last
 }
 

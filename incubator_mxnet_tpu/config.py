@@ -344,6 +344,31 @@ config.register(
     "a surviving topology (fresh build_fn + reshard-restore) after a "
     "fatal incarnation loss before re-raising.")
 config.register(
+    "MXTPU_ZERO_STAGE", 0, int,
+    "Default ZeRO stage for SPMDTrainer when the zero_stage argument is "
+    "unset (docs/TRAINING.md 'ZeRO ladder'): 0 replicated, 1 shards "
+    "optimizer state over the data axis (arXiv:2004.13336), 2 adds an "
+    "in-executable gradient reduce-scatter + per-step parameter "
+    "all-gather, 3 keeps parameters sharded at rest with just-in-time "
+    "all-gather in forward/backward — per-chip param+grad+opt memory "
+    "~1/N. Tensors whose leading dim does not divide the data-axis size "
+    "stay replicated.")
+config.register(
+    "MXTPU_COLLECTIVE_QUANT", "none", str,
+    "Block-quantized in-executable collectives for ZeRO stage >= 2 "
+    "(EQuARX-style, arXiv:2506.17615): 'none' (default), 'int8' (~3.9x "
+    "fewer gradient bytes on wire) or '2bit' (~14x) quantize the "
+    "gradient reduce-scatter with per-block scales computed in-graph "
+    "and an error-feedback residual carried as donated state. Parameter "
+    "all-gathers stay full-precision (weight drift; see "
+    "docs/TRAINING.md).")
+config.register(
+    "MXTPU_COLLECTIVE_QUANT_BLOCK", 256, int,
+    "Block size (values per scale) of the quantized collectives and the "
+    "per-block int8 fused allreduce — smaller blocks track mixed "
+    "gradient magnitudes closer at more scale overhead (4 bytes per "
+    "block on the wire). Must be a multiple of 4 for 2bit packing.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

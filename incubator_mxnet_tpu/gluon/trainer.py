@@ -62,6 +62,20 @@ class FusedStep:
     style (arXiv:2004.13336): each rank keeps states for and updates only
     ``index % num_workers == rank`` parameters, then one batched
     collective rebuilds the replicated weights.
+
+    **ZeRO ladder** (``fused_step(zero_stage=...)``, docs/TRAINING.md):
+    stage 1 is ``shard_update``; stage 2 additionally moves the gradient
+    reduction IN-GRAPH (honoring the per-block int8 / 2bit compression
+    hooks) with the update applied only to this rank's OWNED subset,
+    before the same batched weight rebuild. The reduction itself still
+    covers every parameter — the stacked-payload transport and
+    multi-process jit require one identical program per rank — so the
+    gluon rung buys comm/compute fusion and 1/N optimizer state, not
+    owned-only wire; the true per-shard reduce-scatter lives in the
+    mesh-partitioned ``parallel.SPMDTrainer``. Stage 3 (parameters
+    sharded at rest) also needs ``SPMDTrainer`` — the eager trainer
+    keeps full parameters per process, so requesting it engages stage 2
+    with a warning (``last_fallback`` records it).
     """
 
     def __init__(self, trainer: "Trainer"):
@@ -71,6 +85,7 @@ class FusedStep:
         self._flops: Dict[tuple, Optional[float]] = {}
         self.last_flops: Optional[float] = None
         self.shard_update = False
+        self.zero_stage = 0
         # set by Trainer.step when the cross-process allreduce should fuse
         # into the executable; consumed (and cleared) by run()
         self.pending_allreduce = False
@@ -80,9 +95,13 @@ class FusedStep:
     # -- engagement ---------------------------------------------------------
     def wants_ingraph_allreduce(self) -> bool:
         tr = self._trainer
+        # ZeRO-1 keeps the batched HOST collective (its contract: every
+        # rank sees every reduced grad in param.grad()); ZeRO-2 moves
+        # the reduction in-graph restricted to the owned subset, so
+        # shard_update no longer excludes the fused allreduce there
         return (tr._distributed and tr._kvstore is not None
                 and tr._kvstore._updater is None
-                and not self.shard_update
+                and (not self.shard_update or self.zero_stage >= 2)
                 and getattr(tr, "_amp_loss_scaler", None) is None
                 and getattr(tr._updater.optimizer, "_has_fused_core", False))
 
@@ -172,9 +191,12 @@ class FusedStep:
             p._data._grad_fresh = False
             opt._update_count(i)
         if shard:
-            # ZeRO-1: this rank owns (keeps state for, updates) a 1/size
-            # slice of the parameter list; grads were already reduced by
-            # step()'s batched host collective
+            # ZeRO-1/2: this rank owns (keeps state for, updates) a
+            # 1/size slice of the parameter list. Stage 1: grads were
+            # already reduced by step()'s batched host collective.
+            # Stage 2 (ingraph set): the reduction moves inside the
+            # executable below (payload spans ALL entries — see the
+            # grad_select block) and only the owned subset updates
             mine = [(i, p) for i, p in entries if i % size == rank]
         else:
             mine = entries
@@ -194,12 +216,30 @@ class FusedStep:
         compressor = getattr(tr._kvstore, "_compressor", None) \
             if ingraph else None
         multiproc = ingraph and size > 1
+        grad_select = None
         if ingraph:
             from ..parallel.collectives import make_fused_allreduce
 
+            if shard:
+                # ZeRO-2: the in-graph reduction must cover the SAME
+                # tensor list in the same order on EVERY rank — the
+                # stacked-payload transport sums by list position and
+                # multi-process jit requires one identical program — so
+                # the payload is ALL entries' grads; the executable then
+                # updates only this rank's owned subset (grad_select
+                # picks the owned positions out of the reduced list).
+                # The owned-only wire reduction needs the
+                # mesh-partitioned SPMDTrainer (docs/TRAINING.md).
+                pos = {i: j for j, (i, _) in enumerate(entries)}
+                grad_select = tuple(pos[i] for i, _ in mine)
+                payload = [p._data._grad._data for _, p in entries]
+                pkeys = [i for i, _ in entries]
+            else:
+                payload = list(gs)
+                pkeys = [i for i, _ in mine]
             gs, reduce_fn = make_fused_allreduce(
-                list(gs), compression=compression, compressor=compressor,
-                keys=[i for i, _ in mine])
+                payload, compression=compression, compressor=compressor,
+                keys=pkeys)
             gs = tuple(gs)
         else:
             reduce_fn = None
@@ -211,12 +251,18 @@ class FusedStep:
                      multiproc, compression,
                      # the 2bit threshold is baked into the traced
                      # reduce_fn — a changed value must recompile
-                     getattr(compressor, "threshold", None), shard)
+                     getattr(compressor, "threshold", None), shard,
+                     # ZeRO-2: the payload spans ALL entries and the
+                     # owned positions are baked into the trace
+                     grad_select,
+                     tuple((i, p.shape) for i, p in entries)
+                     if grad_select is not None else None)
         jfn = self._cache.get(cache_key)
         if jfn is None:
             telemetry.note_cache_miss("trainer.step",
                                       detail=f"fused:{type(opt).__name__}")
-            jfn = self._build(opt, len(mine), reduce_fn, multiproc)
+            jfn = self._build(opt, len(mine), reduce_fn, multiproc,
+                              grad_select)
             self._cache[cache_key] = jfn
 
         if multiproc:
@@ -285,14 +331,20 @@ class FusedStep:
             self._zeros_cache[key] = z
         return z
 
-    def _build(self, opt, n: int, reduce_fn, multiproc: bool):
+    def _build(self, opt, n: int, reduce_fn, multiproc: bool,
+               grad_select=None):
         """Compile the whole-model executable. Weights (arg 0) and states
         (arg 2) are donated — in-place in HBM; grads (arg 1) are NOT, the
-        buffers stay user-readable after the step."""
+        buffers stay user-readable after the step. ``grad_select``
+        (ZeRO-2): positions of this rank's owned grads within the
+        reduced payload list — the reduction covers every entry (one
+        identical program per rank), the update only the owned subset."""
 
         def fused(ws, gs, states, lrs, wds, ts, rescale, *rng):
             if reduce_fn is not None:
                 gs = reduce_fn(gs)
+            if grad_select is not None:
+                gs = [gs[j] for j in grad_select]
             keys = jax.random.split(rng[0], n) if rng else (None,) * n
             new_ws, new_states = [], []
             for w, g, st, lr, wd, t, k in zip(ws, gs, states, lrs, wds,
@@ -685,12 +737,37 @@ class Trainer:
         return self._optimizer
 
     def fused_step(self, enabled: bool = True,
-                   shard_update: bool = False) -> "Trainer":
+                   shard_update: bool = False,
+                   zero_stage: Optional[int] = None) -> "Trainer":
         """Configure the FusedStep engine: ``fused_step(False)`` pins the
         per-parameter path; ``fused_step(shard_update=True)`` additionally
-        shards optimizer state/update across replicas (ZeRO-1)."""
+        shards optimizer state/update across replicas (ZeRO-1).
+
+        ``zero_stage`` spells the ladder explicitly (docs/TRAINING.md
+        "ZeRO ladder"): 0 replicated, 1 == ``shard_update``, 2 moves
+        the gradient reduction in-graph with the update restricted to
+        the owned subset. Stage 3 needs parameters sharded at rest —
+        ``parallel.SPMDTrainer`` territory — so the eager trainer
+        degrades it to stage 2 with a warning."""
+        if zero_stage is None:
+            zero_stage = 1 if shard_update else 0
+        zero_stage = int(zero_stage)
+        if zero_stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_stage {zero_stage} not in (0, 1, 2, 3)")
+        if zero_stage >= 3:
+            import warnings
+
+            warnings.warn(
+                "ZeRO-3 keeps parameters sharded at rest, which the "
+                "eager gluon Trainer cannot express (each process owns "
+                "full parameters); engaging ZeRO-2. Use "
+                "parallel.SPMDTrainer(zero_stage=3) for stage 3.")
+            self._fused.last_fallback = \
+                "zero-3 degraded to zero-2 (eager trainer keeps full params)"
+            zero_stage = 2
         self._fused_mode = bool(enabled)
-        self._fused.shard_update = bool(shard_update)
+        self._fused.zero_stage = zero_stage
+        self._fused.shard_update = zero_stage >= 1
         return self
 
     def superstep(self, net, loss_fn,

@@ -20,6 +20,8 @@ from .collectives import (allreduce_across_processes, allreduce_arrays,
                           init_distributed, pmean, psum)
 from .spmd import SPMDTrainer, shard_params
 from . import superstep
+from . import zero
+from .zero import ZeroPlan
 from .superstep import stack_window, superstep_window
 from .pipeline import (PipelineTrainer, pipeline_apply,
                        pipeline_apply_1f1b, pipeline_apply_interleaved,

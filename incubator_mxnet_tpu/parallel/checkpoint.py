@@ -517,6 +517,20 @@ def restore_sharded(prefix: str, trainer, data_iter=None, *,
     if engine is not None:
         engine.finish()
 
+    # cross-STAGE portability (ZeRO ladder, docs/TRAINING.md): tensors
+    # come back in the checkpoint's recorded layout (or the reshard
+    # engine's choice); a trainer with a stage >= 2 ZeRO plan then
+    # re-places them to ITS at-rest layout — a stage-0 save restores
+    # onto a stage-3 trainer with parameters sharded 1/N, a stage-3
+    # save onto a stage-2 trainer replicated — and a quantized plan
+    # resets error-feedback residuals saved on a different topology.
+    # Plan-less and stage-0/1 trainers keep the recorded layout (the
+    # PR 7 contract; stage-1 weights live sharded after any step
+    # regardless). Values are identical either way.
+    hook = getattr(trainer, "apply_zero_placement", None)
+    if callable(hook):
+        hook()
+
     if data_iter is not None:
         from ..data.state import restore_sidecars
 

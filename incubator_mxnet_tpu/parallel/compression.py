@@ -1,4 +1,5 @@
-"""2-bit gradient compression with error feedback.
+"""Gradient compression with error feedback: fixed-threshold 2-bit and
+EQuARX-style per-block quantizers.
 
 Reference semantic (``src/kvstore/gradient_compression.cc``): each value
 of (gradient + residual) maps to one of three codes — ``+threshold`` if
@@ -7,6 +8,15 @@ per byte (16x less wire traffic than fp32, 4x less than int8); whatever
 the code did NOT transmit stays in a local residual that is added to the
 next step's gradient (error feedback), so the compressed sum converges to
 the true sum over time.
+
+The *block* quantizers below (``quantize_int8_blocks``,
+``quantize_2bit_blocks``) generalize that hook the EQuARX way
+(arXiv:2506.17615): one scale per BLOCK of values, computed in-graph, so
+a tensor mixing large and tiny gradients does not lose the tiny ones to
+a single whole-tensor scale. They are the payload format of both the
+cross-process fused allreduce (``collectives.make_fused_allreduce``) and
+the in-executable quantized reduce-scatter/all-gather of the ZeRO ladder
+(``collectives.reduce_scatter_quantized``, ``parallel/zero.py``).
 
 The transport here is the compiled cross-process collective
 (`collectives.allreduce_arrays`): every process contributes its packed
@@ -80,3 +90,118 @@ class GradientCompression:
     def decompress(self, packed: jax.Array, shape,
                    dtype=jnp.float32) -> jax.Array:
         return dequantize_2bit(packed, shape, self.threshold, dtype)
+
+
+# ---------------------------------------------------------------------------
+# EQuARX-style per-block quantizers (arXiv:2506.17615)
+# ---------------------------------------------------------------------------
+def _blocked(flat: jax.Array, block: int) -> jax.Array:
+    """Pad a flat f32 vector to a whole number of blocks -> (nb, block)."""
+    nb = -(-flat.size // block)
+    pad = nb * block - flat.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(nb, block)
+
+
+def quantize_int8_blocks(g: jax.Array, block: int,
+                         residual: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(gradient, residual) -> (int8 codes ``(nb*block,)``, per-block f32
+    scales ``(nb,)``, new residual).
+
+    Symmetric int8 with one scale per ``block`` values: ``scale_b =
+    max|x_b| / 127`` — a tensor mixing large and tiny gradients keeps
+    the tiny blocks' resolution (the whole-tensor-scale scheme maps them
+    all to 0). The quantization error of every value goes to the
+    residual, so repeated transmissions converge to the true value even
+    below one quantization step."""
+    gf = g.astype(jnp.float32).reshape(-1) + residual.reshape(-1)
+    b = _blocked(gf, block)
+    scale = jnp.maximum(jnp.max(jnp.abs(b), axis=-1, keepdims=True),
+                        1e-20) / 127.0
+    q = jnp.clip(jnp.round(b / scale), -127, 127).astype(jnp.int8)
+    deq = (q.astype(jnp.float32) * scale).reshape(-1)[:gf.size]
+    new_residual = (gf - deq).reshape(g.shape)
+    return q.reshape(-1), scale.reshape(-1), new_residual
+
+
+def dequantize_int8_blocks(q: jax.Array, scales: jax.Array, shape,
+                           dtype=jnp.float32) -> jax.Array:
+    """Per-block int8 codes -> dequantized values of ``shape``."""
+    import numpy as np
+
+    n = int(np.prod(shape)) if shape else 1
+    nb = scales.size
+    vals = (q.reshape(nb, -1).astype(jnp.float32)
+            * scales.reshape(nb, 1)).reshape(-1)[:n]
+    return vals.reshape(shape).astype(dtype)
+
+
+def quantize_2bit_blocks(g: jax.Array, block: int,
+                         residual: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-block ternarization: codes pack 4/byte like the fixed-threshold
+    scheme, but the magnitude is the BLOCK's own ``max|x_b|`` (threshold
+    ``scale_b/2``) computed in-graph — no hand-tuned global threshold.
+    Returns (packed uint8 ``(nb*block/4,)``, scales ``(nb,)``, new
+    residual). ``block`` must be a multiple of 4."""
+    if block % 4:
+        raise ValueError(f"2bit block size must be a multiple of 4, "
+                         f"got {block}")
+    gf = g.astype(jnp.float32).reshape(-1) + residual.reshape(-1)
+    b = _blocked(gf, block)
+    scale = jnp.maximum(jnp.max(jnp.abs(b), axis=-1, keepdims=True), 1e-20)
+    pos = b >= scale / 2
+    neg = b <= -scale / 2
+    deq = jnp.where(pos, scale, 0.0) + jnp.where(neg, -scale, 0.0)
+    new_residual = (gf - deq.reshape(-1)[:gf.size]).reshape(g.shape)
+    codes = (jnp.where(pos, _CODE_POS, 0)
+             + jnp.where(neg, _CODE_NEG, 0)).astype(jnp.uint8).reshape(-1)
+    quads = codes.reshape(-1, 4)
+    packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+              | (quads[:, 3] << 6))
+    return packed, scale.reshape(-1), new_residual
+
+
+def dequantize_2bit_blocks(packed: jax.Array, scales: jax.Array, shape,
+                           dtype=jnp.float32) -> jax.Array:
+    import numpy as np
+
+    n = int(np.prod(shape)) if shape else 1
+    nb = scales.size
+    quads = jnp.stack([(packed >> s) & 3 for s in (0, 2, 4, 6)], axis=-1)
+    codes = quads.reshape(nb, -1)
+    vals = jnp.where(codes == _CODE_POS, scales.reshape(nb, 1),
+                     jnp.where(codes == _CODE_NEG,
+                               -scales.reshape(nb, 1), 0.0))
+    return vals.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+class Int8BlockCompression:
+    """Stateful per-key error-feedback store for the per-block int8
+    scheme — the int8 face of :class:`GradientCompression`, owned by the
+    kvstore for ``{'type': 'int8'}`` and by callers of
+    ``make_fused_allreduce(compression='int8')``."""
+
+    def __init__(self, block: int = 0):
+        if block <= 0:
+            from ..config import config
+
+            block = int(config.get("MXTPU_COLLECTIVE_QUANT_BLOCK"))
+        if block <= 0:
+            raise ValueError("block must be positive")
+        self.block = int(block)
+        self._residuals: Dict[object, jax.Array] = {}
+
+    def compress(self, key, g: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, jnp.float32)
+        q, scales, new_res = quantize_int8_blocks(g, self.block, res)
+        self._residuals[key] = new_res
+        return q, scales
+
+    def decompress(self, payload, shape, dtype=jnp.float32) -> jax.Array:
+        q, scales = payload
+        return dequantize_int8_blocks(q, scales, shape, dtype)

@@ -176,9 +176,19 @@ class SPMDTrainer:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS,
                  *, donate: bool = True,
-                 shard_weight_update: bool = False):
+                 shard_weight_update: bool = False,
+                 zero_stage: Optional[int] = None,
+                 collective_quant: Optional[str] = None,
+                 zero_remat: Optional[bool] = None):
         # donate/shard_weight_update are keyword-only: a removed middle
         # parameter must fail loudly on stale positional call sites
+        #
+        # ZeRO ladder (docs/TRAINING.md): ``zero_stage`` 0-3 (default:
+        # MXTPU_ZERO_STAGE; ``shard_weight_update=True`` is the stage-1
+        # back-compat spelling), ``collective_quant`` none/int8/2bit
+        # block-quantizes the stage>=2 gradient reduce-scatter (default:
+        # MXTPU_COLLECTIVE_QUANT), ``zero_remat`` controls the stage-3
+        # just-in-time re-gather in backward (default: on at stage 3).
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -203,55 +213,75 @@ class SPMDTrainer:
         self._frozen = {n: p for n, p in self._param_objs.items()
                         if p.grad_req == "null"}
 
-        # place params on the mesh per their rules (default: replicated)
-        def shard_of(p):
+        # ZeRO plan (parallel/zero.py): which stage of the ladder, which
+        # tensors shard, whether the collectives quantize. Stage 1 is
+        # the pre-existing "Automatic Cross-Replica Sharding of Weight
+        # Update" behavior (arXiv:2004.13336): optimizer-state leaves of
+        # REPLICATED params shard over the data axis and XLA's SPMD
+        # partitioner computes each replica's 1/N update slice. Stages
+        # 2/3 swap in the zero.build_step body (in-graph reduce-scatter,
+        # parameters sharded at rest).
+        from . import zero as zero_mod
+
+        def _is_replicated(p):
+            return (p._sharding is None
+                    or all(e is None for e in tuple(p._sharding)))
+
+        stage = zero_mod.resolve_stage(zero_stage, shard_weight_update)
+        quant = zero_mod.resolve_quant(collective_quant)
+        self.zero_plan = None
+        if stage or quant != "none":
+            self.zero_plan = zero_mod.ZeroPlan(
+                self.mesh, data_axis, stage, quant,
+                zero_mod.default_block(),
+                shapes={n: tuple(p._data._data.shape)
+                        for n, p in self._trainable.items()},
+                dtypes={n: p._data._data.dtype
+                        for n, p in self._trainable.items()},
+                replicated={n: _is_replicated(p)
+                            for n, p in self._trainable.items()},
+                remat=zero_remat)
+
+        # place params on the mesh per their rules (default: replicated;
+        # ZeRO-3 shards eligible params at rest)
+        def shard_of(p, name=None):
             spec = p._sharding if p._sharding is not None else PartitionSpec()
+            if (name is not None and self.zero_plan is not None
+                    and _is_replicated(p)):
+                rest = self.zero_plan.param_rest_spec(name)
+                if rest is not None:
+                    spec = rest
             return NamedSharding(self.mesh, spec)
 
-        self.params = {n: jax.device_put(p._data._data, shard_of(p))
+        self.params = {n: jax.device_put(p._data._data, shard_of(p, n))
                        for n, p in self._trainable.items()}
         self.frozen = {n: jax.device_put(p._data._data, shard_of(p))
                        for n, p in self._frozen.items()}
         self.opt_state = self.tx.init(self.params)
-        if shard_weight_update:
-            # Cross-replica weight-update sharding (PAPERS.md: "Automatic
-            # Cross-Replica Sharding of Weight Update in Data-Parallel
-            # Training", the ZeRO-1 idea expressed the XLA way): shard
-            # optimizer-state leaves of REPLICATED params over the data
-            # axis. XLA's SPMD partitioner then computes each replica's
-            # 1/N slice of the update (converting the gradient AllReduce
-            # into a ReduceScatter where profitable); the freshly updated
-            # weights inherit the sharding — stored 1/N per chip and
-            # AllGathered on use in the next forward — no manual
-            # collectives, ~1/N optimizer-state AND weight memory at rest.
-            n_data = self.mesh.shape[data_axis]
-            shapes = {n: tuple(a.shape) for n, a in self.params.items()}
-            eligible = {
-                n for n, shp in shapes.items()
-                if shp and shp[0] % n_data == 0
-                and str(self.params[n].sharding.spec) ==
-                str(PartitionSpec())}
-
-            def reshard(path, leaf):
-                # optimizer-state pytrees mirror the params dict, so the
-                # innermost dict key on the leaf's path IS the param name
-                name = None
-                for entry in reversed(path):
-                    key = getattr(entry, "key", None)
-                    if isinstance(key, str):
-                        name = key
-                        break
-                if (name in eligible
-                        and tuple(getattr(leaf, "shape", ()))
-                        == shapes[name]):
-                    return jax.device_put(leaf, NamedSharding(
-                        self.mesh, PartitionSpec(data_axis)))
-                return leaf
-
-            self.opt_state = jax.tree_util.tree_map_with_path(
-                reshard, self.opt_state)
+        if self.zero_plan is not None and self.zero_plan.stage >= 1:
+            self.opt_state = zero_mod.shard_opt_state(
+                self.zero_plan, self.opt_state, self.params)
+            if self.zero_plan.quantized():
+                # error-feedback residual rides inside the donated
+                # opt_state (checkpointed / resumed with it)
+                self.opt_state = zero_mod.wrap_opt_state(
+                    self.opt_state,
+                    self.zero_plan.init_residuals(self.params))
         self._batch_sharding = NamedSharding(self.mesh,
                                              PartitionSpec(data_axis))
+        if self.zero_plan is not None:
+            self.zero_last_stats = self.zero_plan.publish(
+                "spmd.step", self.params, self.opt_state, self.frozen)
+            self._wire_per_step = float(
+                self.zero_last_stats["wire_bytes_per_step"])
+            self._wire_counter = telemetry.counter(
+                "mxtpu_collective_wire_bytes_total",
+                "cumulative per-chip bytes-on-wire of the fused step's "
+                "collectives (static schedule x steps)", site="spmd.step")
+        else:
+            self.zero_last_stats = None
+            self._wire_per_step = 0.0
+            self._wire_counter = None
 
     # -- the fused step -----------------------------------------------------
     def _build_step(self, n_data: int, n_label: int):
@@ -263,6 +293,17 @@ class SPMDTrainer:
 
         precision = matmul_precision_for(
             p.dtype for p in self.params.values())
+
+        if self.zero_plan is not None and self.zero_plan.ingraph():
+            # ZeRO-2/3 step body (parallel/zero.py): in-graph gradient
+            # reduce-scatter (block-quantized when configured), sharded
+            # update, params re-placed to their at-rest layout — same
+            # signature/donation contract, so run_steps/run_superstep
+            # compile it into their loops unchanged
+            from . import zero as zero_mod
+
+            return zero_mod.build_step(self.zero_plan, loss_of, tx,
+                                       precision)
 
         def step(train_p, frozen_p, opt_state, rng, data_arrays,
                  label_arrays):
@@ -357,7 +398,14 @@ class SPMDTrainer:
                 self.params, self.frozen, self.opt_state, loss = fn(
                     self.params, self.frozen, self.opt_state, rng,
                     data_arrays, label_arrays)
+        self._note_wire(1)
         return loss
+
+    def _note_wire(self, k: int) -> None:
+        """Account k steps' worth of collective bytes-on-wire (static
+        schedule; mxtpu_collective_wire_bytes_total)."""
+        if self._wire_counter is not None and self._wire_per_step:
+            self._wire_counter.inc(self._wire_per_step * k)
 
     def _flops_for(self, key, data, labels) -> Optional[float]:
         """Per-step cost-analysis FLOPs, computed once per step-cache
@@ -479,6 +527,7 @@ class SPMDTrainer:
                 self.params, self.frozen, self.opt_state, loss = fn(
                     self.params, self.frozen, self.opt_state, rng,
                     data_arrays, label_arrays)
+        self._note_wire(n)
         return loss
 
     # -- superstep: K distinct batches per dispatch -------------------------
@@ -620,7 +669,48 @@ class SPMDTrainer:
             _random.rollback_keys(c0)
             raise
         self._num_steps += k
+        self._note_wire(k)
         return losses
+
+    def apply_zero_placement(self) -> None:
+        """Re-place restored state to this trainer's ZeRO at-rest layout
+        (called by ``restore_sharded`` after a restore — cross-STAGE
+        portability): stage >= 2 plans re-place their eligible
+        parameters (stage 2 replicated, stage 3 sharded 1/N over the
+        data axis), stages >= 1 re-shard optimizer-state leaves, and a
+        quantized plan rebuilds error-feedback residuals whose saved
+        device dimension does not match the live mesh (a topology-
+        changing restore: the per-device untransmitted remainders of the
+        old mesh are meaningless row-wise on the new one — error
+        feedback restarts from zero with a warning, training state is
+        untouched). Values are never changed; no-op without a plan or
+        when layouts already agree. Stage-0/1 trainers (and plan-less
+        ones) keep the checkpoint's recorded layout — stage-1 weights
+        live sharded after any step regardless."""
+        plan = self.zero_plan
+        if plan is None:
+            return
+        from . import zero as zero_mod
+
+        if plan.stage >= 2:
+            for n in list(self.params):
+                if n not in plan.eligible:
+                    continue
+                spec = plan.param_rest_spec(n) or PartitionSpec()
+                want = NamedSharding(self.mesh, spec)
+                arr = self.params[n]
+                if not want.is_equivalent_to(arr.sharding, arr.ndim):
+                    self.params[n] = jax.device_put(arr, want)
+        if plan.stage >= 1:
+            inner, resid = zero_mod.split_opt_state(self.opt_state)
+            inner = zero_mod.shard_opt_state(plan, inner, self.params)
+            if resid is not None:
+                resid = zero_mod.check_residuals(plan, resid)
+            self.opt_state = inner if resid is None \
+                else zero_mod.wrap_opt_state(inner, resid)
+        if self.zero_last_stats is not None:
+            self.zero_last_stats = plan.publish(
+                "spmd.step", self.params, self.opt_state, self.frozen)
 
     def sync_to_net(self) -> None:
         """Write the trainer-owned arrays back into the Block's Parameters

@@ -1,0 +1,507 @@
+"""ZeRO-2/3 sharded data-parallel training inside the one donated
+executable (docs/TRAINING.md "ZeRO ladder").
+
+PR 2/3 stopped at ZeRO-1: ``shard_weight_update=True`` places
+optimizer-state leaves sharded over the data axis and lets XLA's SPMD
+partitioner compute each replica's 1/N slice of the update
+("Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training", arXiv:2004.13336). This module extends the ladder:
+
+* **stage 2** — the fused gradient allreduce becomes an in-graph
+  **reduce-scatter**: gradients of eligible tensors are constrained to
+  ``PartitionSpec(axis)`` right after ``value_and_grad``, so each
+  replica materializes only its 1/N gradient shard, runs the optimizer
+  ``update_fn`` math on just that shard (composing with the ZeRO-1
+  sharded optimizer state), and the freshly updated parameters are
+  constrained back to replicated — ONE all-gather per step, inside the
+  same executable.
+* **stage 3** — parameters are sharded **at rest** (1/N per chip);
+  the forward/backward all-gathers them just in time (XLA inserts the
+  gathers where the math needs full tensors), and ``jax.remat`` around
+  the loss frees the gathered copies after the forward, re-gathering
+  in backward — per-chip parameter + gradient + optimizer memory all
+  scale as ~1/N.
+* **quantized collectives** — with ``MXTPU_COLLECTIVE_QUANT`` set
+  (EQuARX, arXiv:2506.17615), the gradient reduce-scatter runs as an
+  explicit block-quantized exchange (``collectives.
+  reduce_scatter_quantized``): per-block scales computed in-graph,
+  int8 or packed-2bit codes as the only cross-device gradient bytes,
+  and an error-feedback residual carried as donated state inside
+  ``opt_state``. This path compiles the forward/backward through
+  ``shard_map`` so the per-device partial gradients exist to be
+  quantized — batch statistics (BatchNorm) become per-replica and
+  dropout masks decorrelate per shard (true-DP semantics; the
+  unquantized stages keep global-batch semantics bit-for-bit).
+
+Eligibility is per tensor: an at-rest-replicated tensor whose leading
+dim divides the data-axis size. Everything else (TP-sharded params,
+scalars, ragged leading dims) keeps the stage-0 path — correctness
+never depends on divisibility.
+
+Wire accounting: this box cannot measure ICI bytes, but the collective
+schedule is static, so :meth:`ZeroPlan.wire_stats` computes the exact
+per-chip bytes each step puts on the wire (ring reduce-scatter /
+all-gather move ``S*(N-1)/N``, allreduce ``2S*(N-1)/N``; quantized legs
+count their code + scale payloads). Published as ``mxtpu_collective_*``
+/ ``mxtpu_zero_*`` telemetry and a ``kind: "collective"`` JSONL record
+(tools/telemetry_report.py prints the section).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .collectives import (QUANT_MODES, quantized_payload_bytes,
+                          reduce_scatter_quantized)
+
+STAGES = (0, 1, 2, 3)
+
+_OPTAX_KEY = "optax"
+_RESIDUAL_KEY = "zero_residual"
+
+
+def resolve_stage(explicit: Optional[int],
+                  shard_weight_update: bool = False) -> int:
+    """The trainer's ZeRO stage: the explicit argument wins, then the
+    ``MXTPU_ZERO_STAGE`` knob; ``shard_weight_update=True`` floors the
+    result at 1 (it IS stage 1 — back-compat spelling)."""
+    if explicit is None:
+        from ..config import config
+
+        stage = int(config.get("MXTPU_ZERO_STAGE"))
+    else:
+        stage = int(explicit)
+    if stage not in STAGES:
+        raise ValueError(f"zero_stage {stage} not in {STAGES}")
+    if shard_weight_update:
+        stage = max(stage, 1)
+    return stage
+
+
+def resolve_quant(explicit: Optional[str]) -> str:
+    if explicit is None:
+        from ..config import config
+
+        quant = str(config.get("MXTPU_COLLECTIVE_QUANT") or "none")
+    else:
+        quant = str(explicit)
+    quant = quant.strip().lower() or "none"
+    if quant not in QUANT_MODES:
+        raise ValueError(
+            f"collective quant {quant!r} not in {QUANT_MODES}")
+    return quant
+
+
+def default_block() -> int:
+    from ..config import config
+
+    return int(config.get("MXTPU_COLLECTIVE_QUANT_BLOCK"))
+
+
+def bytes_per_chip(tree) -> int:
+    """At-rest bytes one chip holds for a pytree of (possibly sharded)
+    jax arrays: the per-device shard size of every leaf. The measured
+    quantity behind the ZeRO memory table (docs/SCALING.md)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "shape"):
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shp = sharding.shard_shape(tuple(leaf.shape))
+        else:
+            shp = tuple(leaf.shape)
+        itemsize = jnp.dtype(leaf.dtype).itemsize
+        total += int(np.prod(shp)) * itemsize if shp else itemsize
+    return total
+
+
+class ZeroPlan:
+    """Per-trainer ZeRO decision record: stage, quantization, which
+    tensors shard, and the static per-step wire schedule.
+
+    Built from the trainable parameters BEFORE placement (eligibility
+    looks at the declared sharding rules, not the current device
+    layout), then drives placement, the step body, and telemetry.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str, stage: int, quant: str,
+                 block: int, shapes: Dict[str, tuple],
+                 dtypes: Dict[str, Any], replicated: Dict[str, bool],
+                 *, remat: Optional[bool] = None):
+        if quant != "none" and stage < 2:
+            raise ValueError(
+                "MXTPU_COLLECTIVE_QUANT requires zero_stage >= 2 (the "
+                "quantized collective replaces the stage-2 gradient "
+                "reduce-scatter)")
+        self.mesh = mesh
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        self.stage = int(stage)
+        self.quant = quant
+        self.block = int(block)
+        self.remat = bool(stage >= 3) if remat is None else bool(remat)
+        self.shapes = dict(shapes)
+        self.dtypes = {k: jnp.dtype(v) for k, v in dtypes.items()}
+        if quant != "none":
+            tp = sorted(k for k, r in replicated.items() if not r)
+            if tp:
+                raise ValueError(
+                    "quantized collectives require a pure data-parallel "
+                    f"mesh; parameters {tp[:3]}... carry tensor-parallel "
+                    "sharding rules")
+        self.eligible = {
+            name for name, shp in shapes.items()
+            if replicated.get(name, True) and len(shp) >= 1
+            and shp[0] % self.n == 0 and self.n > 1 and self.stage >= 1}
+        self._wire = self._wire_schedule()
+
+    # -- predicates ---------------------------------------------------------
+    def ingraph(self) -> bool:
+        """Stages 2/3 change the step body; 0/1 keep the PR 2/3 one."""
+        return self.stage >= 2 and self.n > 1
+
+    def quantized(self) -> bool:
+        return self.quant != "none" and self.ingraph()
+
+    # -- placement ----------------------------------------------------------
+    def param_rest_spec(self, name: str) -> Optional[PartitionSpec]:
+        """At-rest PartitionSpec override for a trainable parameter:
+        stage 3 shards eligible tensors over the axis; ``None`` means
+        keep the parameter's own placement."""
+        if self.stage >= 3 and name in self.eligible:
+            return PartitionSpec(self.axis)
+        return None
+
+    def _sharded(self, x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec(self.axis)))
+
+    def _replicated(self, x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec()))
+
+    def constrain_grads(self, grads: Dict[str, Any]) -> Dict[str, Any]:
+        """The ZeRO-2 move (unquantized path): constrain eligible grads
+        to ``P(axis)`` right after autodiff, turning XLA's gradient
+        allreduce into a reduce-scatter — each replica materializes only
+        its shard."""
+        return {n: self._sharded(g) if n in self.eligible else g
+                for n, g in grads.items()}
+
+    def place_params(self, train_p: Dict[str, Any]) -> Dict[str, Any]:
+        """Constrain freshly updated params to their at-rest layout:
+        stage 2 all-gathers them back to replicated (once per step,
+        inside the executable); stage 3 keeps them sharded."""
+        if self.stage >= 3:
+            return {n: self._sharded(w) if n in self.eligible else w
+                    for n, w in train_p.items()}
+        return {n: self._replicated(w) if n in self.eligible else w
+                for n, w in train_p.items()}
+
+    def init_residuals(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Error-feedback residual state: per eligible tensor, each
+        device's untransmitted remainder — a global ``(n, *shape)`` f32
+        array sharded over the axis (row d = device d's residual),
+        donated with ``opt_state`` every step."""
+        resid = {}
+        for name in sorted(self.eligible):
+            shp = (self.n,) + tuple(self.shapes[name])
+            resid[name] = jax.device_put(
+                jnp.zeros(shp, jnp.float32),
+                NamedSharding(self.mesh, PartitionSpec(self.axis)))
+        return resid
+
+    # -- wire accounting ----------------------------------------------------
+    def _wire_schedule(self) -> Dict[str, float]:
+        """Exact per-chip bytes-on-wire per step, from the static
+        collective schedule (ring collectives: RS and AG each move
+        ``S*(n-1)/n`` per chip, AR ``2S*(n-1)/n``)."""
+        n = self.n
+        frac = (n - 1) / n if n > 1 else 0.0
+        rs = ag = ar = 0.0
+        rs_fp = 0.0    # what the unquantized RS leg would move (grads
+        #                reduce in the parameter's own dtype)
+        for name, shp in self.shapes.items():
+            elems = int(np.prod(shp)) if shp else 1
+            nbytes = elems * self.dtypes[name].itemsize
+            if name not in self.eligible:
+                # (stage 0 has an empty eligible set, so it lands here
+                # for every tensor: one full allreduce each)
+                if n > 1:
+                    ar += 2 * nbytes * frac
+                continue
+            # stages 1-3: grad reduce-scatter + param all-gather (JIT in
+            # forward for stage 3 — twice under remat, the backward
+            # re-gathers)
+            gathers = 2 if (self.stage >= 3 and self.remat) else 1
+            ag += gathers * nbytes * frac
+            rs_fp += nbytes * frac
+            if self.quantized():
+                # reduce_scatter_quantized quantizes n peer-addressed
+                # ROWS of elems/n values, each block-padded
+                # independently; a device ships (n-1)/n of its payload
+                # (its own row stays local)
+                rs += n * quantized_payload_bytes(
+                    elems // n, self.quant, self.block) * frac
+            else:
+                rs += nbytes * frac
+        total = rs + ag + ar
+        baseline = 0.0       # stage-0 unquantized equivalent
+        for name, shp in self.shapes.items():
+            elems = int(np.prod(shp)) if shp else 1
+            baseline += 2 * elems * self.dtypes[name].itemsize * frac
+        return {
+            "wire_bytes_per_step": total,
+            "rs_wire_bytes_per_step": rs,
+            "ag_wire_bytes_per_step": ag,
+            "ar_wire_bytes_per_step": ar,
+            "rs_fp32_wire_bytes_per_step": rs_fp,
+            "allreduce_baseline_bytes_per_step": baseline,
+            "quant_fraction": (rs / rs_fp) if (self.quantized() and rs_fp)
+            else 1.0,
+        }
+
+    def wire_stats(self) -> Dict[str, float]:
+        return dict(self._wire)
+
+    # -- telemetry ----------------------------------------------------------
+    def publish(self, site: str, params, opt_state, frozen=None) -> Dict:
+        """Set the per-chip-memory gauges + per-step wire gauges and
+        emit the ``kind: "collective"`` JSONL record. Returns the stats
+        dict (benchmark/zero_bench.py consumes it)."""
+        from .. import telemetry
+
+        if isinstance(opt_state, dict) and _OPTAX_KEY in opt_state:
+            inner = opt_state[_OPTAX_KEY]
+            resid = opt_state.get(_RESIDUAL_KEY, {})
+        else:
+            inner, resid = opt_state, {}
+        stats = {
+            "kind": "collective", "site": site, "stage": self.stage,
+            "quant": self.quant, "block": self.block,
+            "n_shards": self.n, "eligible_tensors": len(self.eligible),
+            "total_tensors": len(self.shapes),
+            "param_bytes_per_chip": bytes_per_chip(params),
+            "opt_bytes_per_chip": bytes_per_chip(inner),
+            "residual_bytes_per_chip": bytes_per_chip(resid),
+            "grad_bytes_per_chip": self.grad_bytes_per_chip(),
+        }
+        if frozen is not None:
+            stats["frozen_bytes_per_chip"] = bytes_per_chip(frozen)
+        stats.update(self._wire)
+        for kind in ("param", "opt", "residual", "grad"):
+            telemetry.gauge(
+                f"mxtpu_zero_{kind}_bytes_per_chip",
+                f"at-rest per-chip {kind} bytes under the ZeRO plan",
+                site=site).set(float(stats[f"{kind}_bytes_per_chip"]))
+        telemetry.gauge(
+            "mxtpu_collective_wire_bytes_per_step",
+            "per-chip bytes-on-wire one train step moves (static "
+            "schedule)", site=site).set(self._wire["wire_bytes_per_step"])
+        telemetry.gauge(
+            "mxtpu_collective_quant_fraction",
+            "quantized / fp32 bytes on the gradient reduce-scatter leg",
+            site=site).set(self._wire["quant_fraction"])
+        telemetry.jsonl_emit(stats)
+        return stats
+
+    def grad_bytes_per_chip(self) -> int:
+        """Gradient bytes a chip materializes at the update point:
+        eligible tensors exist only as 1/n shards (stages >= 2),
+        everything else at full size."""
+        total = 0
+        for name, shp in self.shapes.items():
+            elems = int(np.prod(shp)) if shp else 1
+            nbytes = elems * self.dtypes[name].itemsize
+            if self.stage >= 2 and name in self.eligible:
+                total += nbytes // self.n
+            else:
+                total += nbytes
+        return total
+
+
+# ---------------------------------------------------------------------------
+# opt_state wrapping (error-feedback residuals ride inside the donated
+# optimizer state, so checkpointing / superstep / supervisor loops see ONE
+# opaque state tree; dict keys sort "optax" < "zero_residual", keeping the
+# optax leaves' flatten order — and so the checkpoint's opt/{i} indices —
+# identical to an unwrapped trainer's)
+# ---------------------------------------------------------------------------
+def wrap_opt_state(inner, residuals) -> Dict[str, Any]:
+    return {_OPTAX_KEY: inner, _RESIDUAL_KEY: residuals}
+
+
+def split_opt_state(opt_state):
+    if isinstance(opt_state, dict) and _OPTAX_KEY in opt_state:
+        return opt_state[_OPTAX_KEY], opt_state[_RESIDUAL_KEY]
+    return opt_state, None
+
+
+def check_residuals(plan: ZeroPlan, resid: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+    """Validate restored error-feedback residuals against the live plan:
+    a residual leaf must be ``(plan.n, *tensor_shape)`` sharded over the
+    axis. A topology-changing restore brings back the SAVE mesh's
+    device dimension — those rows are per-OLD-device remainders with no
+    meaning on the new mesh, so they reset to zeros (with a warning;
+    error feedback restarts, the training state itself is exact).
+    Same-topology restores pass through untouched."""
+    out = {}
+    stale = []
+    for name in sorted(plan.eligible):
+        want_shape = (plan.n,) + tuple(plan.shapes[name])
+        r = resid.get(name)
+        if r is not None and tuple(r.shape) == want_shape:
+            out[name] = r
+            continue
+        stale.append(name)
+        out[name] = jax.device_put(
+            jnp.zeros(want_shape, jnp.float32),
+            NamedSharding(plan.mesh, PartitionSpec(plan.axis)))
+    if stale:
+        import logging
+
+        logging.getLogger("mxtpu.zero").warning(
+            "error-feedback residuals for %d tensor(s) (e.g. %s) were "
+            "saved on a different topology (device dim != %d); they "
+            "reset to zero — error feedback restarts, model/optimizer "
+            "state is unaffected", len(stale), stale[0], plan.n)
+    return out
+
+
+def shard_opt_state(plan: ZeroPlan, opt_state, params: Dict[str, Any]):
+    """Shard optimizer-state leaves of eligible params over the axis —
+    the ZeRO-1 move (arXiv:2004.13336), shared by stages 1-3. A leaf
+    belongs to a param when the innermost dict key on its tree path is
+    the param's name and the shape matches."""
+    shapes = {n: tuple(a.shape) for n, a in params.items()}
+    eligible = plan.eligible
+
+    def reshard(path, leaf):
+        name = None
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if isinstance(key, str):
+                name = key
+                break
+        if (name in eligible
+                and tuple(getattr(leaf, "shape", ())) == shapes[name]):
+            return jax.device_put(leaf, NamedSharding(
+                plan.mesh, PartitionSpec(plan.axis)))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(reshard, opt_state)
+
+
+# ---------------------------------------------------------------------------
+# the stage-2/3 step bodies
+# ---------------------------------------------------------------------------
+def build_step(plan: ZeroPlan, loss_of: Callable, tx, precision: str
+               ) -> Callable:
+    """The fused train-step body for ZeRO stages 2/3 — same signature
+    and donation contract as ``SPMDTrainer._build_step``'s, so
+    ``run_steps`` / ``run_superstep`` compile it into their loops
+    unchanged: ``(train_p, frozen_p, opt_state, rng, data_arrays,
+    label_arrays) -> (train_p, frozen_p, opt_state, loss)``."""
+    import optax
+
+    if plan.quantized():
+        grads_of = _build_quantized_grads(plan, loss_of)
+    else:
+        grads_of = None
+
+    def step(train_p, frozen_p, opt_state, rng, data_arrays,
+             label_arrays):
+        inner, resid = split_opt_state(opt_state)
+        with jax.default_matmul_precision(precision):
+            if grads_of is not None:
+                loss, aux, grads, resid = grads_of(
+                    train_p, frozen_p, rng, data_arrays, label_arrays,
+                    resid)
+            else:
+                lf = jax.checkpoint(loss_of) if plan.remat else loss_of
+                (loss, aux), grads = jax.value_and_grad(
+                    lf, has_aux=True)(train_p, frozen_p, rng,
+                                      data_arrays, label_arrays)
+                grads = plan.constrain_grads(grads)
+            updates, inner = tx.update(grads, inner, train_p)
+            train_p = optax.apply_updates(train_p, updates)
+            train_p = plan.place_params(train_p)
+        for n, v in aux.items():
+            if n in frozen_p:
+                frozen_p = {**frozen_p, n: v}
+            elif n in train_p:
+                train_p = {**train_p, n: v}
+        opt_state = wrap_opt_state(inner, resid) if resid is not None \
+            else inner
+        return train_p, frozen_p, opt_state, loss
+
+    return step
+
+
+def _build_quantized_grads(plan: ZeroPlan, loss_of: Callable) -> Callable:
+    """The shard_map body computing per-device partial gradients and
+    reducing them through the block-quantized reduce-scatter. Returns
+    ``(loss, aux, grads, new_residuals)`` at the global level: loss/aux
+    replicated, eligible grads sharded ``P(axis)``, residuals sharded on
+    their device dim."""
+    from .mesh import shard_map_compat
+
+    axis, n = plan.axis, plan.n
+    P = PartitionSpec
+
+    def body(train_p, frozen_p, rng, data_arrays, label_arrays, resid):
+        # decorrelate per-shard RNG draws (dropout) — the unquantized
+        # path draws ONE global mask; here each shard draws its own
+        rng_local = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+        def local_loss(tp):
+            return loss_of(tp, frozen_p, rng_local, data_arrays,
+                           label_arrays)
+
+        if plan.remat:
+            # stage-3 memory contract holds on the quantized path too:
+            # the just-in-time-gathered full params are freed after the
+            # forward and re-gathered by the remat'd backward
+            local_loss = jax.checkpoint(local_loss)
+        (l, aux), g = jax.value_and_grad(local_loss, has_aux=True)(train_p)
+        # loss_of means over the LOCAL batch; equal shard sizes make the
+        # global mean the average of local means — and each device's
+        # gradient contribution 1/n of its local-mean gradient
+        loss = jax.lax.psum(l, axis) / n
+        aux = {k: jax.lax.pmean(v, axis) for k, v in aux.items()}
+        grads, new_resid = {}, {}
+        for name in g:
+            c = g[name].astype(jnp.float32) / n
+            if name in plan.eligible:
+                shard, r = reduce_scatter_quantized(
+                    c, axis, n, plan.quant, plan.block, resid[name][0])
+                shp = plan.shapes[name]
+                shard_shape = (shp[0] // n,) + tuple(shp[1:])
+                grads[name] = shard.reshape(shard_shape).astype(
+                    g[name].dtype)
+                new_resid[name] = r[None]
+            else:
+                grads[name] = jax.lax.psum(c, axis).astype(g[name].dtype)
+        return loss, aux, grads, new_resid
+
+    grad_specs = {name: P(axis) if name in plan.eligible else P()
+                  for name in plan.shapes}
+
+    def grads_of(train_p, frozen_p, rng, data_arrays, label_arrays,
+                 resid):
+        shm = shard_map_compat(
+            body, mesh=plan.mesh,
+            in_specs=(P(), P(), P(), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(), grad_specs, P(axis)),
+            check_vma=False)
+        return shm(train_p, frozen_p, rng, data_arrays, label_arrays,
+                   resid)
+
+    return grads_of

@@ -173,7 +173,14 @@ def test_set_gradient_compression_api():
     assert kv._compressor is not None
     kv.set_gradient_compression({"type": "int8"})
     assert kv._compression == "int8"
-    assert kv._compressor is None
+    # PR 10: int8 became per-block scales + error feedback (EQuARX,
+    # arXiv:2506.17615) — the kvstore now owns an Int8BlockCompression
+    # residual store, like 2bit owns its GradientCompression
+    from incubator_mxnet_tpu.parallel.compression import (
+        Int8BlockCompression)
+
+    assert isinstance(kv._compressor, Int8BlockCompression)
+    assert kv._compressor.block > 0
     with pytest.raises(ValueError):
         kv.set_gradient_compression({"type": "fp4"})
 

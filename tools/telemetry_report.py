@@ -207,6 +207,24 @@ def summarize(path: str, merge: bool = False) -> str:
             lines.append(f"  !! {bad} checkpoint write(s) failed before "
                          "commit (torn writes are never visible; see "
                          "docs/RESILIENCE.md)")
+    coll: Dict[str, Dict] = {}
+    for r in records:
+        if r.get("kind") == "collective":
+            coll[r.get("site", "?")] = r      # last record per site wins
+    if coll:
+        lines.append("")
+        lines.append(f"{'collectives':24s} {'stage':>6s} {'quant':>6s} "
+                     f"{'wire/step':>12s} {'quant frac':>11s} "
+                     f"{'param B/chip':>13s} {'opt B/chip':>11s}")
+        for site in sorted(coll):
+            r = coll[site]
+            lines.append(
+                f"{site:24s} {int(r.get('stage', 0)):6d} "
+                f"{str(r.get('quant', 'none')):>6s} "
+                f"{r.get('wire_bytes_per_step', 0) / 2**20:10.2f}Mi "
+                f"{r.get('quant_fraction', 1.0):11.3f} "
+                f"{int(r.get('param_bytes_per_chip', 0)):13d} "
+                f"{int(r.get('opt_bytes_per_chip', 0)):11d}")
     bench = [r for r in records if r.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -267,6 +285,17 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
         out[f"resilience/{ev}"] = float(n)
     if ck_ms:
         out["resilience/checkpoint_p50_ms"] = _pctl(sorted(ck_ms), 50)
+    for r in records:
+        # last collective record per site wins (trainer rebuilds emit one
+        # each); the diffable ZeRO/quantization footprint of a run
+        if r.get("kind") == "collective":
+            site = r.get("site", "?")
+            for key in ("wire_bytes_per_step", "quant_fraction",
+                        "param_bytes_per_chip", "opt_bytes_per_chip",
+                        "grad_bytes_per_chip"):
+                if isinstance(r.get(key), (int, float)):
+                    out[f"collective/{site}/{key}"] = float(r[key])
+            out[f"collective/{site}/stage"] = float(r.get("stage", 0))
     return out
 
 
