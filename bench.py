@@ -192,18 +192,32 @@ def _dispatch_stats(trainer):
     return (d / s) if s else None
 
 
-def _row_extra(trainer, args, per, mode):
+def _superstep_on():
+    """Whether ``MXTPU_SUPERSTEP`` engages the K-steps-per-dispatch
+    executable (resolved lazily; the driver loop never imports jax)."""
+    from incubator_mxnet_tpu.parallel.superstep import superstep_enabled
+
+    return superstep_enabled()
+
+
+def _row_extra(trainer, args, per, mode, superstep_k=None):
     """Attach ``dispatches_per_step`` and ``host_overhead_frac`` to the
     row. ``host_overhead_frac`` = 1 - ondevice_per/dispatched_per: the
     share of a host-dispatched step's wall time that the on-device loop
     amortizes away (dispatch latency + per-step host work). ``mode`` says
     which side ``per`` measured ('ondevice' for superstep/run_steps rows,
     'dispatch' for per-step rows); the other side is measured here with
-    one short auxiliary fit. Never fails the row."""
+    one short auxiliary fit. ``superstep_k`` records the window sizes the
+    superstep fit dispatched (the [short, long] fit windows) so a round
+    whose superstep silently fell back to eager is visible in the
+    artifact next to its grown ``dispatches_per_step``. Never fails the
+    row."""
     global LAST_ROW_EXTRA
     import jax
 
     extra = {}
+    if superstep_k is not None:
+        extra["superstep_k"] = superstep_k
     dps = _dispatch_stats(trainer)
     if dps is not None:
         extra["dispatches_per_step"] = round(dps, 4)
@@ -370,7 +384,8 @@ def bench_mlp():
     bx, by = batch_fn(0)
     x = _place(mesh, bx, jnp.bfloat16)
     y = _place(mesh, by)
-    _row_extra(trainer, (x, y), per, "ondevice")
+    _row_extra(trainer, (x, y), per, "ondevice",
+               superstep_k=[ITERS, ITERS2])
     return (batch / per / n_dev, "images/sec/chip",
             "mlp_mnist_train_throughput_per_chip", "mlp",
             _tfs(trainer, (x, y), per, n_dev))
@@ -416,7 +431,8 @@ def bench_lstm_ptb():
     bx, by = batch_fn(0)
     x = _place(mesh, bx)
     y = _place(mesh, by)
-    _row_extra(trainer, (x, y), per, "ondevice")
+    _row_extra(trainer, (x, y), per, "ondevice",
+               superstep_k=[ITERS, ITERS2])
     return (B * T / per / n_dev, "tokens/sec/chip",
             "lstm_ptb_train_throughput_per_chip", "lstm_ptb",
             _tfs(trainer, (x, y), per, n_dev))
@@ -464,7 +480,13 @@ def bench_bert():
 
 def bench_ssd():
     """config[4]: SSD-300 VOC with AMP (bf16 tower) — target assignment
-    (multibox_target) fused into the jitted step."""
+    (multibox_target) fused into the jitted step.
+
+    ISSUE 11: the conv workloads join the superstep — when
+    ``MXTPU_SUPERSTEP`` engages, the row drives ``run_superstep`` over K
+    DISTINCT batches per dispatch (mirroring the mlp/lstm rows from
+    PR 8) so per-step host dispatch stops polluting the number;
+    ``dispatches_per_step`` in the row makes the attribution direct."""
     import jax
     import jax.numpy as jnp
 
@@ -494,25 +516,41 @@ def bench_ssd():
     trainer = parallel.SPMDTrainer(
         net, ssd_loss, "sgd",
         {"learning_rate": 1e-3, "momentum": 0.9}, mesh=mesh)
-    x = _place(mesh, np.random.rand(B, 3, 300, 300).astype(np.float32),
-               jnp.bfloat16)
-    label = np.full((B, 4, 5), -1.0, np.float32)
-    rs = np.random.RandomState(0)
-    for i in range(B):
-        cx, cy = rs.uniform(0.3, 0.7, 2)
-        w, h = rs.uniform(0.2, 0.4, 2)
-        label[i, 0] = [rs.randint(20), cx - w / 2, cy - h / 2,
-                       cx + w / 2, cy + h / 2]
-    y = _place(mesh, label)
-    per = _timed_steps(trainer, (x, y))
-    _row_extra(trainer, (x, y), per, "dispatch")
+
+    def batch_fn(i):
+        rs = np.random.RandomState(i)
+        img = rs.rand(B, 3, 300, 300).astype(np.float32)
+        label = np.full((B, 4, 5), -1.0, np.float32)
+        for j in range(B):
+            cx, cy = rs.uniform(0.3, 0.7, 2)
+            w, h = rs.uniform(0.2, 0.4, 2)
+            label[j, 0] = [rs.randint(20), cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2]
+        return img, label
+
+    bx, by = batch_fn(0)
+    x = _place(mesh, bx, jnp.bfloat16)
+    y = _place(mesh, by)
+    if _superstep_on():
+        per = _superstep_fit(trainer, batch_fn, [jnp.bfloat16, None])
+        _row_extra(trainer, (x, y), per, "ondevice",
+                   superstep_k=[ITERS, ITERS2])
+    else:
+        per = _timed_steps(trainer, (x, y))
+        _row_extra(trainer, (x, y), per, "dispatch")
     return (B / per / n_dev, "images/sec/chip",
             "ssd300_train_throughput_per_chip", "ssd300",
             _tfs(trainer, (x, y), per, n_dev))
 
 
 def bench_resnet():
-    """config[1]: ResNet-50 — the north-star headline metric."""
+    """config[1]: ResNet-50 — the north-star headline metric.
+
+    ISSUE 11: the headline conv workload joins the superstep — when
+    ``MXTPU_SUPERSTEP`` engages, the row drives ``run_superstep`` over K
+    DISTINCT batches per dispatch (mirroring the mlp/lstm rows from
+    PR 8); ``dispatches_per_step``/``host_overhead_frac`` in the row
+    attribute what the on-device loop amortized."""
     import jax
     import jax.numpy as jnp
 
@@ -531,11 +569,22 @@ def bench_resnet():
     trainer = parallel.SPMDTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
-    x = _place(mesh, np.random.rand(batch, 3, 224, 224).astype(np.float32),
-               jnp.bfloat16)
-    y = _place(mesh, np.random.randint(0, 1000, (batch,)).astype(np.float32))
-    per = _timed_steps(trainer, (x, y))
-    _row_extra(trainer, (x, y), per, "dispatch")
+
+    def batch_fn(i):
+        rs = np.random.RandomState(i)
+        return (rs.rand(batch, 3, 224, 224).astype(np.float32),
+                rs.randint(0, 1000, (batch,)).astype(np.float32))
+
+    bx, by = batch_fn(0)
+    x = _place(mesh, bx, jnp.bfloat16)
+    y = _place(mesh, by)
+    if _superstep_on():
+        per = _superstep_fit(trainer, batch_fn, [jnp.bfloat16, None])
+        _row_extra(trainer, (x, y), per, "ondevice",
+                   superstep_k=[ITERS, ITERS2])
+    else:
+        per = _timed_steps(trainer, (x, y))
+        _row_extra(trainer, (x, y), per, "dispatch")
     return (batch / per / n_dev, "images/sec/chip",
             "resnet50_v1_train_throughput_per_chip", "resnet50",
             _tfs(trainer, (x, y), per, n_dev))

@@ -57,11 +57,14 @@ def fit_time(run, n1, n2, reps=2):
 
 
 # (ci, co, hw, k, stride, name) — the four key 3x3 shapes, the strided
-# 3x3 + 1x1 downsample projections, and two 1x1 body projections
+# 3x3 + 1x1 downsample projections (incl. the l3/l4 strided shapes the
+# MXTPU_CONV_STRIDE2 auto heuristic routes to the prephase layout —
+# PROFILE.md "conv v3"), and two 1x1 body projections
 SHAPES_A = [
     (64, 64, 56, 3, 1, "l1.c2"), (128, 128, 28, 3, 1, "l2.c2"),
     (256, 256, 14, 3, 1, "l3.c2"), (512, 512, 7, 3, 1, "l4.c2"),
     (128, 128, 56, 3, 2, "l2.c2s"), (256, 512, 56, 1, 2, "l2.ds"),
+    (256, 256, 28, 3, 2, "l3.c2s"), (512, 512, 14, 3, 2, "l4.c2s"),
     (256, 64, 56, 1, 1, "l1.c1b"), (1024, 256, 14, 1, 1, "l3.c1b"),
 ]
 
@@ -257,7 +260,14 @@ def part_d():
     """Whole-model fused_resnet50_v1 vs zoo resnet50_v1 train step (the
     prize row): fused >= zoo - 5% means the BN-stat savings survived the
     kernel swap end-to-end and the BENCH headline flips to the fused
-    model (VERDICT r5 item 2's 'done' bar)."""
+    model (VERDICT r5 item 2's 'done' bar).
+
+    ISSUE 11: the flip decision is recorded as a ``kind:"decision"``
+    JSONL record through the PR 4 sink (ratio, winner, the conv knob
+    states, and both models' per-step/MFU numbers) so BENCH rounds carry
+    the provenance of which kernel configuration produced the headline;
+    online-vs-offline MFU prints for the fused model the same way it
+    does for the zoo model (both loops share the code path below)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
@@ -294,13 +304,14 @@ def part_d():
         y = jax.device_put(jnp.asarray(rs.randint(0, 1000, (batch,)),
                                        np.float32), sh)
         per = _steps_fit(tr, x, y)
-        results[label] = per
         flops = tr.step_cost_analysis(x, y)
         offline_mfu = telemetry.mfu_percent(flops / per) if flops else None
         gauge = telemetry.get_registry().find("mxtpu_mfu_percent",
                                               site="spmd.run_steps")
         online_mfu = gauge.value if gauge is not None and gauge.value \
             else None
+        results[label] = {"per": per, "offline_mfu": offline_mfu,
+                          "online_mfu": online_mfu}
         mfu_txt = ""
         if offline_mfu is not None:
             mfu_txt = f"  offline MFU {offline_mfu:.1f}%"
@@ -311,10 +322,30 @@ def part_d():
         print(f"{label:5s} train step: {per * 1e3:.1f} ms/step "
               f"{batch / per:.0f} img/s{mfu_txt}", flush=True)
         del tr, x, y, net
-    ratio = results["zoo"] / results["fused"]
+    ratio = results["zoo"]["per"] / results["fused"]["per"]
     verdict = "PRIZE CLAIMED" if ratio >= 0.95 else "still behind"
+    record = {
+        "kind": "decision", "metric": "resnet_decision_part_d",
+        "ratio": round(ratio, 4), "threshold": 0.95,
+        "winner": "fused" if ratio >= 0.95 else "zoo",
+        "epilogue": str(config.get("MXTPU_CONV_EPILOGUE")),
+        "conv_bwd": str(config.get("MXTPU_CONV_BWD")),
+        "stride2": str(config.get("MXTPU_CONV_STRIDE2")),
+        "batch_per_chip": 128,
+    }
+    for label, res in results.items():
+        record[f"{label}_ms_per_step"] = round(res["per"] * 1e3, 3)
+        for k in ("offline_mfu", "online_mfu"):
+            if res[k] is not None:
+                record[f"{label}_{k}_pct"] = round(res[k], 2)
+    try:
+        telemetry.jsonl_emit(record)
+    except Exception:
+        pass  # observability can never break the decision row
     print(f"fused/zoo speed ratio {ratio:.3f} (>=0.95 flips the BENCH "
-          f"headline) -> {verdict}", flush=True)
+          f"headline) -> {verdict} "
+          f"[epilogue={record['epilogue']} bwd={record['conv_bwd']} "
+          f"stride2={record['stride2']}]", flush=True)
 
 
 def main():

@@ -182,6 +182,25 @@ config.register(
     "matmul instead of one matmul per tap). Off by default: the VMEM "
     "concatenate trips a Mosaic layout bug for some channel counts.")
 config.register(
+    "MXTPU_CONV_EPILOGUE", "auto", str,
+    "v3 residual-epilogue fusion for the fused Pallas ResNet "
+    "(ops/pallas_conv.py + fused_resnet.py): 'auto'/'1' (default) fold "
+    "each bottleneck's BN+ReLU+residual-add join into the NEXT conv's "
+    "VMEM prologue (the residual streams as a third kernel operand; the "
+    "joined activation is emitted once for the shortcut consumer), so "
+    "no XLA elementwise op sits between fused conv kernels; '0' "
+    "restores the v2 per-bottleneck XLA joins.")
+config.register(
+    "MXTPU_CONV_STRIDE2", "auto", str,
+    "Strided-conv layout of the fused Pallas conv forward: 'unroll' "
+    "(v2) keeps the per-image in-kernel phase decomposition (prologue "
+    "stays in VMEM; nb capped at 8 to bound kernel code size), "
+    "'prephase' phase-decomposes the prologue-applied input in XLA "
+    "(phase-major channels; taps become plain batched slices, nb "
+    "uncapped). 'auto' (default) picks prephase exactly where the "
+    "unroll cap starves the MXU — shapes whose row target wants more "
+    "than 8 images per program (PROFILE.md 'conv v3').")
+config.register(
     "MXTPU_CONV_BWD", "auto", str,
     "Backward implementation for the fused Pallas conv+BN kernels: "
     "'auto' (default) runs the Pallas dx/dW kernels at stride 1 and the "

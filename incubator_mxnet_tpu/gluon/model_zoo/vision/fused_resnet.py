@@ -16,30 +16,62 @@ Layout divergences from the unfused zoo model (documented, deliberate):
 * each bottleneck is ONE tape node (a pure jnp chain of three fused
   convs + the residual join), so autograd replays it as a unit.
 
-The 7x7 stem (C_in=3 starves the MXU lane dimension) and the residual
-join run in plain XLA.
+The 7x7 stem (C_in=3 starves the MXU lane dimension) runs in plain XLA.
 
-Backward (round 6): each fused conv's custom vjp runs the v2 Pallas
-backward kernels — the dx transpose-conv with the BN-statistics
-cotangents folded in VMEM and the dW contraction with in-VMEM prologue
-recompute — replacing the XLA NHWC transpose-conv backward that kept
-this model 1.8x behind the zoo end-to-end (``MXTPU_CONV_BWD`` governs
-dispatch; docs/TRAINING.md "Fused ResNet").
+**v3 residual-epilogue chain (``MXTPU_CONV_EPILOGUE``, default on):** the
+bottleneck's own junction — ``out = relu(bn3(y3) + shortcut)`` — is no
+longer an XLA elementwise op between opaque Pallas calls. Each
+bottleneck hands its successor a *pending join* ``(y3, a3, b3, r, ar,
+br)`` (the raw conv3 output, its folded BN coefficients, and the
+shortcut with its affine — identity: ar=1/br=0; downsample: the folded
+BN of the projection) and the successor's conv1 kernel performs the
+whole conv+BN+ReLU+residual-add junction in VMEM, emitting the joined
+activation once for its own shortcut path (``emit_act``). The network
+head materialises the final pending join with one XLA op. With the knob
+off the v2 per-bottleneck joins are restored — both wirings are the
+same math (``tests/test_fused_resnet.py`` proves whole-model gradient
+agreement to <2e-5 rel L2).
+
+Backward: each fused conv's custom vjp runs the v2/v3 Pallas backward
+kernels — the dx transpose-conv with the BN-statistics cotangents folded
+in VMEM (plus, v3, the dReLU mask and residual-cotangent pass-through)
+and the dW contraction with in-VMEM prologue recompute
+(``MXTPU_CONV_BWD`` governs dispatch; docs/TRAINING.md "Fused ResNet").
 """
 
 from __future__ import annotations
 
-import functools
+from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
-from ....ndarray import invoke
+from ....config import config
+from ....ndarray import NDArray, invoke
 from ... import HybridBlock
 from ...nn import Dense, HybridSequential
 from .... import autograd
+
+
+def conv_epilogue_enabled() -> bool:
+    """The ``MXTPU_CONV_EPILOGUE`` knob: 'auto'/'1' (default) thread the
+    pending-join chain through the fused bottlenecks; '0' restores the
+    v2 per-bottleneck XLA joins."""
+    return str(config.get("MXTPU_CONV_EPILOGUE")).strip().lower() not in (
+        "0", "off", "false", "no", "never")
+
+
+class _PendingJoin(NamedTuple):
+    """A bottleneck junction deferred into the next conv's VMEM prologue:
+    ``consumer_input = relu(a*y + b + ar*r + br)``. Members are NDArrays
+    (tape outputs of the producing bottleneck node)."""
+
+    y: "NDArray"
+    a: "NDArray"
+    b: "NDArray"
+    r: "NDArray"
+    ar: "NDArray"
+    br: "NDArray"
 
 
 def _coeffs(y, s, ss, g, be, rm, rv, training, eps):
@@ -54,20 +86,27 @@ def _coeffs(y, s, ss, g, be, rm, rv, training, eps):
     return a, b, rm, rv
 
 
-def _fused_bottleneck(x, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2, rv2,
-                      w3, g3, be3, rm3, rv3, *ds, stride=1, training=True,
-                      eps=1e-5, interpret=None):
-    """One ResNet v1 bottleneck, fully fused. x: (N, H, W, Cin) NHWC.
+def _bneck_core(x_in, join, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2, rv2,
+                w3, g3, be3, rm3, rv3, ds, stride, training, eps,
+                interpret):
+    """The shared bottleneck body. Exactly one of ``x_in`` (materialised
+    input activation) / ``join`` (pending 6-tuple) is set; conv1 either
+    consumes the plain activation or performs the junction in its VMEM
+    prologue, emitting the joined activation for the shortcut path.
+    Returns ``(pending_parts, stats)`` where pending_parts is the
+    (y3, a3, b3, r, ar, br) tuple of THIS bottleneck's junction."""
+    from ....ops.pallas_conv import fused_conv_bn
 
-    Returns ``out`` in eval mode; ``(out, m1, v1, m2, v2, m3, v3[, md,
-    vd])`` in training mode (batch stats for the running-stat updates).
-    """
-    from ....ops.pallas_conv import fused_conv_bn, pallas_conv_available
-
-    if interpret is None:
-        interpret = not pallas_conv_available()
-    y1, s1, ss1 = fused_conv_bn(x, w1, stride=1, pad=0, relu=False,
-                                interpret=interpret)
+    if join is not None:
+        y_in, a_in, b_in, r_in, ar_in, br_in = join
+        y1, s1, ss1, act = fused_conv_bn(
+            y_in, w1, a_in, b_in, stride=1, pad=0, relu=True,
+            resid=r_in, resid_scale=ar_in, resid_shift=br_in,
+            emit_act=True, interpret=interpret)
+    else:
+        act = x_in
+        y1, s1, ss1 = fused_conv_bn(act, w1, stride=1, pad=0, relu=False,
+                                    interpret=interpret)
     a1, b1, m1, v1 = _coeffs(y1, s1, ss1, g1, be1, rm1, rv1, training, eps)
     y2, s2, ss2 = fused_conv_bn(y1, w2, a1, b1, stride=stride, pad=1,
                                 relu=True, interpret=interpret)
@@ -77,19 +116,86 @@ def _fused_bottleneck(x, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2, rv2,
     a3, b3, m3, v3 = _coeffs(y3, s3, ss3, g3, be3, rm3, rv3, training, eps)
     if ds:
         wd, gd, bed, rmd, rvd = ds
-        yd, sd, ssd = fused_conv_bn(x, wd, stride=stride, pad=0,
+        yd, sd, ssd = fused_conv_bn(act, wd, stride=stride, pad=0,
                                     relu=False, interpret=interpret)
         ad, bd, md, vd = _coeffs(yd, sd, ssd, gd, bed, rmd, rvd, training,
                                  eps)
-        shortcut = yd.astype(jnp.float32) * ad + bd
+        r_out, ar_out, br_out = yd, ad, bd
     else:
-        shortcut = x.astype(jnp.float32)
-    out = jnp.maximum(y3.astype(jnp.float32) * a3 + b3 + shortcut, 0.0)
-    out = out.astype(x.dtype)
+        co = y3.shape[-1]
+        r_out = act
+        ar_out = jnp.ones((co,), jnp.float32)
+        br_out = jnp.zeros((co,), jnp.float32)
+    stats = (m1, v1, m2, v2, m3, v3) + ((md, vd) if ds else ())
+    return (y3, a3, b3, r_out, ar_out, br_out), stats
+
+
+def _join_parts(y, a, b, r, ar, br):
+    """Materialise a pending junction in XLA: relu(a*y + b + ar*r + br).
+    The v2 per-bottleneck join, and the v3 chain's single head join."""
+    out = jnp.maximum(y.astype(jnp.float32) * a + b
+                      + r.astype(jnp.float32) * ar + br, 0.0)
+    return out.astype(y.dtype)
+
+
+def _fused_bottleneck(x, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2, rv2,
+                      w3, g3, be3, rm3, rv3, *ds, stride=1, training=True,
+                      eps=1e-5, interpret=None):
+    """One ResNet v1 bottleneck, fully fused, v2 wiring (materialised
+    join). x: (N, H, W, Cin) NHWC.
+
+    Returns ``out`` in eval mode; ``(out, m1, v1, m2, v2, m3, v3[, md,
+    vd])`` in training mode (batch stats for the running-stat updates).
+    """
+    from ....ops.pallas_conv import pallas_conv_available
+
+    if interpret is None:
+        interpret = not pallas_conv_available()
+    pend, stats = _bneck_core(x, None, w1, g1, be1, rm1, rv1, w2, g2,
+                              be2, rm2, rv2, w3, g3, be3, rm3, rv3, ds,
+                              stride, training, eps, interpret)
+    out = _join_parts(*pend)
     if training:
-        stats = (m1, v1, m2, v2, m3, v3) + ((md, vd) if ds else ())
         return (out,) + stats
     return out
+
+
+def _fused_bottleneck_defer(x, w1, g1, be1, rm1, rv1, w2, g2, be2, rm2,
+                            rv2, w3, g3, be3, rm3, rv3, *ds, stride=1,
+                            training=True, eps=1e-5, interpret=None):
+    """v3 chain entry: plain activation in, pending join out (the first
+    bottleneck after the stem)."""
+    from ....ops.pallas_conv import pallas_conv_available
+
+    if interpret is None:
+        interpret = not pallas_conv_available()
+    pend, stats = _bneck_core(x, None, w1, g1, be1, rm1, rv1, w2, g2,
+                              be2, rm2, rv2, w3, g3, be3, rm3, rv3, ds,
+                              stride, training, eps, interpret)
+    return pend + (stats if training else ())
+
+
+def _fused_bottleneck_epi(y_in, a_in, b_in, r_in, ar_in, br_in, w1, g1,
+                          be1, rm1, rv1, w2, g2, be2, rm2, rv2, w3, g3,
+                          be3, rm3, rv3, *ds, stride=1, training=True,
+                          eps=1e-5, interpret=None):
+    """v3 chain link: pending join in (consumed by conv1's VMEM
+    prologue, joined activation emitted for the shortcut path), pending
+    join out."""
+    from ....ops.pallas_conv import pallas_conv_available
+
+    if interpret is None:
+        interpret = not pallas_conv_available()
+    pend, stats = _bneck_core(
+        None, (y_in, a_in, b_in, r_in, ar_in, br_in), w1, g1, be1, rm1,
+        rv1, w2, g2, be2, rm2, rv2, w3, g3, be3, rm3, rv3, ds, stride,
+        training, eps, interpret)
+    return pend + (stats if training else ())
+
+
+def _fused_join(y, a, b, r, ar, br):
+    """The chain head: materialise the last pending junction."""
+    return _join_parts(y, a, b, r, ar, br)
 
 
 def _fused_stem(x, w, g, be, rm, rv, *, training=True, eps=1e-5):
@@ -99,7 +205,7 @@ def _fused_stem(x, w, g, be, rm, rv, *, training=True, eps=1e-5):
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     ("NHWC", "HWIO", "NHWC"))
     # bf16 runs natively (f32 preferred_element_type would mix dtypes in
-    # the conv transpose — same constraint as _fused_conv_ref)
+    # the conv transpose — same constraint as _conv_raw)
     low_prec = x.dtype in (jnp.bfloat16, jnp.float16)
     y = lax.conv_general_dilated(
         x, w, (2, 2), [(3, 3), (3, 3)], dimension_numbers=dn,
@@ -157,7 +263,13 @@ class _BNParams:
 
 class FusedBottleneckV1(HybridBlock):
     """Bottleneck v1 (stride on the 3x3, like the zoo BottleneckV1) over
-    the fused Pallas conv+BN kernels; weights HWIO, activations NHWC."""
+    the fused Pallas conv+BN kernels; weights HWIO, activations NHWC.
+
+    Under ``MXTPU_CONV_EPILOGUE`` (default) the block participates in
+    the pending-join chain: it accepts either a plain NDArray or a
+    :class:`_PendingJoin` and returns a :class:`_PendingJoin` —
+    materialise with :func:`materialize` when using a block standalone.
+    """
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  epsilon=1e-5, momentum=0.9, prefix=None, params=None):
@@ -184,30 +296,55 @@ class FusedBottleneckV1(HybridBlock):
                     init="xavier")
                 self.bnd = _BNParams(self, "bnd", channels)
 
+    def _update_running(self, stats):
+        bns = [self.bn1, self.bn2, self.bn3] + (
+            [self.bnd] if self._has_ds else [])
+        m = self._momentum
+        for bn, (mean, var) in zip(bns, zip(stats[0::2], stats[1::2])):
+            bn.running_mean.set_data(
+                bn.running_mean.data() * m + mean.detach() * (1 - m))
+            bn.running_var.set_data(
+                bn.running_var.data() * m + var.detach() * (1 - m))
+
     def forward(self, x, *args):
-        params = self._resolve_params(x)
+        pending_in = isinstance(x, _PendingJoin)
+        params = self._resolve_params(x.y if pending_in else x)
         training = autograd.is_training()
-        ins = [x, params["conv1_weight"]] + self.bn1.resolved(params, "bn1")
-        ins += [params["conv2_weight"]] + self.bn2.resolved(params, "bn2")
-        ins += [params["conv3_weight"]] + self.bn3.resolved(params, "bn3")
+        kwargs = dict(stride=self._stride, training=training,
+                      eps=self._eps)
+        param_ins = [params["conv1_weight"]] \
+            + self.bn1.resolved(params, "bn1") \
+            + [params["conv2_weight"]] + self.bn2.resolved(params, "bn2") \
+            + [params["conv3_weight"]] + self.bn3.resolved(params, "bn3")
         if self._has_ds:
-            ins += [params["convd_weight"]] + self.bnd.resolved(params,
-                                                                "bnd")
-        out = invoke(_fused_bottleneck, ins,
-                     kwargs=dict(stride=self._stride, training=training,
-                                 eps=self._eps),
-                     name="fused_bottleneck")
+            param_ins += [params["convd_weight"]] \
+                + self.bnd.resolved(params, "bnd")
+        if pending_in:
+            out = invoke(_fused_bottleneck_epi, list(x) + param_ins,
+                         kwargs=kwargs, name="fused_bottleneck_epi")
+        elif conv_epilogue_enabled():
+            out = invoke(_fused_bottleneck_defer, [x] + param_ins,
+                         kwargs=kwargs, name="fused_bottleneck_defer")
+        else:
+            out = invoke(_fused_bottleneck, [x] + param_ins,
+                         kwargs=kwargs, name="fused_bottleneck")
+            if training:
+                out, *stats = out
+                self._update_running(stats)
+            return out
+        pend = _PendingJoin(*out[:6])
         if training:
-            bns = [self.bn1, self.bn2, self.bn3] + (
-                [self.bnd] if self._has_ds else [])
-            out, *stats = out
-            m = self._momentum
-            for bn, (mean, var) in zip(bns, zip(stats[0::2], stats[1::2])):
-                bn.running_mean.set_data(
-                    bn.running_mean.data() * m + mean.detach() * (1 - m))
-                bn.running_var.set_data(
-                    bn.running_var.data() * m + var.detach() * (1 - m))
-        return out
+            self._update_running(out[6:])
+        return pend
+
+
+def materialize(x):
+    """Join a :class:`_PendingJoin` into its activation (no-op on plain
+    arrays) — the chain head, and the helper for standalone bottleneck
+    use under the epilogue knob."""
+    if isinstance(x, _PendingJoin):
+        return invoke(_fused_join, list(x), name="fused_join")
+    return x
 
 
 class FusedResNetV1(HybridBlock):
@@ -260,7 +397,7 @@ class FusedResNetV1(HybridBlock):
                 self.bn0.running_mean.data() * m + mu.detach() * (1 - m))
             self.bn0.running_var.set_data(
                 self.bn0.running_var.data() * m + var.detach() * (1 - m))
-        feat = self.stages(stem)
+        feat = materialize(self.stages(stem))
         pooled = invoke(_global_pool, [feat], name="global_avg_pool")
         return self.output(pooled)
 

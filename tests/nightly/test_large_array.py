@@ -22,6 +22,12 @@ NIGHTLY = os.environ.get("MXTPU_NIGHTLY", "0") == "1"
 BIG_1D = 1_100_000_000
 
 
+# the two >=1GB allocation cases are nightly-tier by cost: ~25-55s of
+# the single-core tier-1 budget on this container class (the suite sits
+# at the 870s cap — ISSUE 11 round measurement); the int64-size
+# capability keeps always-on coverage via test_large_take_gather +
+# test_int64_element_count_boundary below
+@pytest.mark.slow
 def test_gigabyte_array_roundtrip():
     x = nd.zeros((BIG_1D,), dtype="int8")
     assert x.size == BIG_1D
@@ -31,6 +37,7 @@ def test_gigabyte_array_roundtrip():
     assert int(x[BIG_1D - 1].asscalar()) == 7
 
 
+@pytest.mark.slow
 def test_large_2d_reduce_and_index():
     # (40000, 30000) int8 = 1.2 GB; row/col indexing at large offsets
     x = nd.ones((40000, 30000), dtype="int8")

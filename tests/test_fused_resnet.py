@@ -208,3 +208,106 @@ def test_fused_resnet50_constructs():
     n_params = len(net.collect_params())
     # 53 convs + 53 BNs (4 tensors) + dense w/b
     assert n_params == 53 + 53 * 4 + 2
+
+
+def test_epilogue_chain_matches_v2_joins():
+    """THE wiring oracle for the v3 residual-epilogue chain: on the same
+    fused model, forward/grads with the pending-join chain
+    (MXTPU_CONV_EPILOGUE on — junctions fused into the next conv's VMEM
+    prologue) must match the v2 per-bottleneck XLA joins to <2e-5 rel L2
+    (same math, same kernels; only where the join happens differs)."""
+    from incubator_mxnet_tpu.config import config
+
+    rs = np.random.RandomState(10)
+    net = fused_resnet.FusedResNetV1([1, 1], [8, 16, 32], classes=4)
+    net.initialize(init="xavier")
+    x = nd.array(rs.rand(2, 3, 16, 16).astype(np.float32))
+    y = nd.array(rs.randint(0, 4, (2,)).astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(epilogue):
+        config.set("MXTPU_CONV_EPILOGUE", epilogue)
+        try:
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+        finally:
+            config.unset("MXTPU_CONV_EPILOGUE")
+        return float(loss.asnumpy()), {
+            p.name: p.grad().asnumpy()
+            for p in net.collect_params().values()
+            if p.grad_req != "null"}
+
+    l_epi, g_epi = run("1")
+    l_v2, g_v2 = run("0")
+    np.testing.assert_allclose(l_epi, l_v2, rtol=1e-6, atol=1e-6)
+    assert g_epi.keys() == g_v2.keys()
+    for k in g_epi:
+        rel = (np.linalg.norm(g_epi[k] - g_v2[k])
+               / max(np.linalg.norm(g_v2[k]), 1e-12))
+        assert rel < 2e-5, (k, rel)
+
+
+def test_epilogue_eval_forward_matches_v2():
+    """Eval mode (running-stat BN coefficients) through the pending-join
+    chain equals the v2 joins."""
+    from incubator_mxnet_tpu.config import config
+
+    rs = np.random.RandomState(11)
+    net = fused_resnet.FusedResNetV1([1, 1], [8, 16, 32], classes=4)
+    net.initialize(init="xavier")
+    x = nd.array(rs.rand(2, 3, 16, 16).astype(np.float32))
+    config.set("MXTPU_CONV_EPILOGUE", "1")
+    try:
+        o_epi = net(x).asnumpy()
+    finally:
+        config.unset("MXTPU_CONV_EPILOGUE")
+    config.set("MXTPU_CONV_EPILOGUE", "0")
+    try:
+        o_v2 = net(x).asnumpy()
+    finally:
+        config.unset("MXTPU_CONV_EPILOGUE")
+    np.testing.assert_allclose(o_epi, o_v2, rtol=1e-5, atol=1e-5)
+
+
+def test_v2_joins_still_match_zoo():
+    """The epilogue-off path (v2 per-bottleneck joins) keeps full zoo
+    parity — the knob is a safe rollback."""
+    from incubator_mxnet_tpu.config import config
+
+    config.set("MXTPU_CONV_EPILOGUE", "0")
+    try:
+        zoo, fused = _build_pair(12)
+        rs = np.random.RandomState(13)
+        x = nd.array(rs.rand(2, 3, 32, 32).astype(np.float32))
+        oz = zoo(x).asnumpy()
+        of = fused(x).asnumpy()
+        np.testing.assert_allclose(of, oz, rtol=2e-3, atol=2e-3)
+    finally:
+        config.unset("MXTPU_CONV_EPILOGUE")
+
+
+def test_pending_join_materialize_helper():
+    """A standalone bottleneck under the epilogue knob returns a pending
+    join; materialize() turns it into the activation a v2 bottleneck
+    would have produced."""
+    from incubator_mxnet_tpu.config import config
+
+    rs = np.random.RandomState(14)
+    blk = fused_resnet.FusedBottleneckV1(16, 1, downsample=True,
+                                         in_channels=8, prefix="t_")
+    blk.initialize(init="xavier")
+    x = nd.array(rs.rand(2, 8, 8, 8).astype(np.float32))
+    config.set("MXTPU_CONV_EPILOGUE", "1")
+    try:
+        pend = blk(x)
+        assert isinstance(pend, fused_resnet._PendingJoin)
+        out = fused_resnet.materialize(pend).asnumpy()
+    finally:
+        config.unset("MXTPU_CONV_EPILOGUE")
+    config.set("MXTPU_CONV_EPILOGUE", "0")
+    try:
+        ref = blk(x).asnumpy()
+    finally:
+        config.unset("MXTPU_CONV_EPILOGUE")
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)

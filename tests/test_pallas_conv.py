@@ -389,3 +389,233 @@ def test_bottleneck_chain_matches_unfused():
     r1 = jax.nn.relu(bn(conv(x, w1, 0), g1, b1))
     ref = bn(conv(r1, w2, 1), g2, b2)
     np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# v3: residual-epilogue fusion + stride-2 layout variants
+# ---------------------------------------------------------------------------
+
+def _epi_operands(seed, n=2, h=8, ci=8, co=16, k=3, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    x = _rand(rs, (n, h, h, ci), dtype)
+    w = _rand(rs, (k, k, ci, co), dtype) * 0.2
+    a = jnp.asarray(rs.rand(ci).astype(np.float32) + 0.5)
+    b = _rand(rs, (ci,))
+    r = _rand(rs, (n, h, h, ci), dtype)
+    ar = jnp.asarray(rs.rand(ci).astype(np.float32) + 0.5)
+    br = _rand(rs, (ci,))
+    return x, w, a, b, r, ar, br
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(k=1, stride=1, pad=0),            # the bottleneck-junction conv1
+    dict(k=3, stride=1, pad=1),
+    dict(k=3, stride=2, pad=1),            # strided, residual streamed
+])
+def test_epilogue_forward_matches_xla(cfg):
+    """conv+BN+ReLU+residual-add in one kernel: the v3 prologue
+    ``relu(a*x + b + ar*r + br)`` plus the emitted joined activation must
+    match the XLA formulation exactly."""
+    from incubator_mxnet_tpu.ops.pallas_conv import _apply_prologue_host
+
+    x, w, a, b, r, ar, br = _epi_operands(20, k=cfg["k"])
+    s_, pad = cfg["stride"], cfg["pad"]
+    y, s, ss, xp = fused_conv_bn(x, w, a, b, stride=s_, pad=pad,
+                                 relu=True, resid=r, resid_scale=ar,
+                                 resid_shift=br, emit_act=True)
+    yr, sr, ssr = _fused_conv_ref(x, w, a, b, s_, pad, True, r=r, ar=ar,
+                                  br=br)
+    xpr = _apply_prologue_host(x, a, b, r=r, ar=ar, br=br, relu=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(xp), np.asarray(xpr),
+                               rtol=1e-5, atol=1e-5, err_msg="emit_act")
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_epilogue_identity_residual_defaults():
+    """resid without scale/shift = the identity shortcut (ar=1, br=0)."""
+    x, w, a, b, r, _, _ = _epi_operands(21)
+    y, s, ss = fused_conv_bn(x, w, a, b, stride=1, pad=1, relu=True,
+                             resid=r)
+    ones = jnp.ones((x.shape[-1],), jnp.float32)
+    zeros = jnp.zeros((x.shape[-1],), jnp.float32)
+    yr, sr, ssr = _fused_conv_ref(x, w, a, b, 1, 1, True, r=r, ar=ones,
+                                  br=zeros)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_emit_act_requires_resid():
+    x, w, a, b, _, _, _ = _epi_operands(22)
+    with pytest.raises(ValueError, match="emit_act requires"):
+        fused_conv_bn(x, w, a, b, stride=1, pad=1, emit_act=True)
+
+
+@pytest.mark.parametrize("mode", ["pallas", "xla"])
+@pytest.mark.parametrize("cfg", [
+    dict(k=1, stride=1, pad=0),
+    dict(k=3, stride=1, pad=1),
+    dict(k=3, stride=2, pad=1),
+])
+def test_epilogue_grads_match_oracle(cfg, mode):
+    """The v3 custom vjp — dx, dw, da, db AND the residual cotangents
+    (dr pass-through, dar, dbr) plus the emitted activation's incoming
+    cotangent — must match jax.vjp over the XLA formulation under every
+    MXTPU_CONV_BWD dispatch mode."""
+    from incubator_mxnet_tpu.ops.pallas_conv import _apply_prologue_host
+
+    x, w, a, b, r, ar, br = _epi_operands(23, k=cfg["k"])
+    s_, pad = cfg["stride"], cfg["pad"]
+
+    def loss_fused(x, w, a, b, r, ar, br):
+        y, s, ss, xp = fused_conv_bn(x, w, a, b, stride=s_, pad=pad,
+                                     relu=True, resid=r, resid_scale=ar,
+                                     resid_shift=br, emit_act=True)
+        return (jnp.sum(jnp.sin(y.astype(jnp.float32)))
+                + jnp.sum(jnp.cos(s * 1e-2))
+                + jnp.sum(jnp.tanh(ss * 1e-3))
+                + jnp.sum(jnp.sin(xp.astype(jnp.float32) * 0.7)))
+
+    def loss_ref(x, w, a, b, r, ar, br):
+        y = _conv_part_ref(x, w, a, b, s_, pad, True, r=r, ar=ar, br=br)
+        xp = _apply_prologue_host(x, a, b, r=r, ar=ar, br=br, relu=True)
+        y32 = y.astype(jnp.float32)
+        return (jnp.sum(jnp.sin(y32))
+                + jnp.sum(jnp.cos(jnp.sum(y32, (0, 1, 2)) * 1e-2))
+                + jnp.sum(jnp.tanh(jnp.sum(y32 * y32, (0, 1, 2)) * 1e-3))
+                + jnp.sum(jnp.sin(xp.astype(jnp.float32) * 0.7)))
+
+    with knob("MXTPU_CONV_BWD", mode):
+        gf = jax.grad(loss_fused, argnums=tuple(range(7)))(x, w, a, b, r,
+                                                           ar, br)
+    gr = jax.grad(loss_ref, argnums=tuple(range(7)))(x, w, a, b, r, ar,
+                                                     br)
+    for got, ref, name in zip(gf, gr,
+                              ("dx", "dw", "da", "db", "dr", "dar",
+                               "dbr")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"{name} mode={mode}")
+
+
+def test_epilogue_drelu_mask_at_zero_crossings():
+    """dReLU convention at EXACT zero crossings of the joined
+    pre-activation: the kernels use the strict ``lin > 0`` mask — a zero
+    pre-activation contributes NOTHING to dx/dr/da/db. Hand-built oracle
+    (jnp.maximum's vjp splits 0.5/0.5 at ties, which is exactly the
+    divergence this test pins down)."""
+    ci, co, n, h = 4, 8, 1, 4
+    x = jnp.zeros((n, h, h, ci), jnp.float32)
+    # lin = a*x + b + ar*r + br with a=1, b=row pattern, r=0, ar=1, br=0:
+    # channel 0 lin = -1 (masked), channel 1 lin = 0 (EXACT crossing,
+    # masked by the strict convention), channels 2/3 lin = +1 (pass)
+    b = jnp.asarray([-1.0, 0.0, 1.0, 1.0], jnp.float32)
+    a = jnp.ones((ci,), jnp.float32)
+    r = jnp.zeros_like(x)
+    ar = jnp.ones((ci,), jnp.float32)
+    br = jnp.zeros((ci,), jnp.float32)
+    w = jnp.ones((1, 1, ci, co), jnp.float32) * 0.5
+
+    with knob("MXTPU_CONV_BWD", "pallas"):
+        def loss(x, r, b):
+            y, s, ss = fused_conv_bn(x, w, a, b, stride=1, pad=0,
+                                     relu=True, resid=r, resid_scale=ar,
+                                     resid_shift=br)
+            return jnp.sum(y)
+
+        dx, dr, db = jax.grad(loss, argnums=(0, 1, 2))(x, r, b)
+    # cotangent of lin per channel = sum over co of w = 4.0 where the
+    # mask passes, 0 where lin <= 0 (strictly: the lin == 0 channel too)
+    expect = np.array([0.0, 0.0, 4.0, 4.0], np.float32)
+    np.testing.assert_array_equal(np.asarray(dx[0, 0, 0]), expect)
+    np.testing.assert_array_equal(np.asarray(dr[0, 0, 0]), expect)
+    np.testing.assert_array_equal(np.asarray(db), expect * n * h * h)
+
+
+def test_epilogue_residual_cotangent_passthrough():
+    """With relu=False the residual cotangent is a pure affine
+    pass-through: dr == dlin * ar exactly (no mask)."""
+    x, w, a, b, r, ar, br = _epi_operands(24, k=1)
+    dy = _rand(np.random.RandomState(25), (2, 8, 8, 16)) * 0.1
+    ds = jnp.zeros((16,), jnp.float32)
+    dss = jnp.zeros((16,), jnp.float32)
+    from incubator_mxnet_tpu.ops.pallas_conv import _conv_bwd_dx_pallas
+
+    y, _, _ = _fused_conv_ref(x, w, a, b, 1, 0, False, r=r, ar=ar, br=br)
+    dx, da, db, dr, dar = _conv_bwd_dx_pallas(
+        x, w, a, b, y, dy, ds, dss, 1, 0, False, True, r=r, ar=ar, br=br)
+    # dlin = transpose-conv(dy, w); dx = dlin*a, dr = dlin*ar — so
+    # dr/ar == dx/a elementwise
+    np.testing.assert_allclose(
+        np.asarray(dr) / np.asarray(ar), np.asarray(dx) / np.asarray(a),
+        rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["unroll", "prephase"])
+@pytest.mark.parametrize("cfg", [
+    dict(h=8, ci=8, co=16, k=3, pad=1),
+    dict(h=9, ci=8, co=8, k=3, pad=1),     # odd H
+    dict(h=8, ci=16, co=32, k=1, pad=0),   # 1x1 downsample
+])
+def test_stride2_layout_variants_match_xla(cfg, variant):
+    """Both stride-2 layouts (v2 per-image unroll, v3 host prephase)
+    must be oracle-equal — incl. odd sizes, 1x1 projections, multi-image
+    blocks and the residual operands."""
+    rs = np.random.RandomState(26)
+    x = _rand(rs, (6, cfg["h"], cfg["h"], cfg["ci"]))
+    w = _rand(rs, (cfg["k"], cfg["k"], cfg["ci"], cfg["co"])) * 0.1
+    a = jnp.asarray(rs.rand(cfg["ci"]).astype(np.float32) + 0.5)
+    b = _rand(rs, (cfg["ci"],))
+    r = _rand(rs, x.shape)
+    with knob("MXTPU_CONV_STRIDE2", variant):
+        y, s, ss = fused_conv_bn(x, w, a, b, stride=2, pad=cfg["pad"],
+                                 relu=True)
+        ye, se, sse, xpe = fused_conv_bn(
+            x, w, a, b, stride=2, pad=cfg["pad"], relu=True, resid=r,
+            emit_act=True)
+    yr, sr, ssr = _fused_conv_ref(x, w, a, b, 2, cfg["pad"], True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5, err_msg=variant)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                               rtol=1e-4, atol=1e-4, err_msg=variant)
+    ones = jnp.ones((cfg["ci"],), jnp.float32)
+    zer = jnp.zeros((cfg["ci"],), jnp.float32)
+    yer, _, _ = _fused_conv_ref(x, w, a, b, 2, cfg["pad"], True, r=r,
+                                ar=ones, br=zer)
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yer),
+                               rtol=1e-5, atol=1e-5,
+                               err_msg=f"{variant} resid")
+
+
+def test_stride2_auto_heuristic_picks_by_row_target():
+    """auto = prephase exactly where the unroll nb cap (8) would starve
+    the MXU: small spatial extents flip, large ones keep the unroll."""
+    from incubator_mxnet_tpu.ops.pallas_conv import _stride2_variant
+
+    assert _stride2_variant(1, 56, 56) == "none"
+    # l2.3x3s: 28x28 out -> 2048/784 = 2 images wanted, cap unbound
+    assert _stride2_variant(2, 28, 28) == "unroll"
+    # l3/l4 strided shapes: 14x14 wants 10, 7x7 wants 41 -> prephase
+    assert _stride2_variant(2, 14, 14) == "prephase"
+    assert _stride2_variant(2, 7, 7) == "prephase"
+    with knob("MXTPU_CONV_STRIDE2", "unroll"):
+        assert _stride2_variant(2, 7, 7) == "unroll"
+    with knob("MXTPU_CONV_STRIDE2", "prephase"):
+        assert _stride2_variant(2, 28, 28) == "prephase"
+
+
+def test_epilogue_bf16():
+    x, w, a, b, r, ar, br = _epi_operands(27, dtype=jnp.bfloat16)
+    y, s, ss, xp = fused_conv_bn(x, w, a, b, stride=1, pad=1, relu=True,
+                                 resid=r, resid_scale=ar, resid_shift=br,
+                                 emit_act=True)
+    assert y.dtype == jnp.bfloat16 and xp.dtype == jnp.bfloat16
+    yr, sr, ssr = _fused_conv_ref(x, w, a, b, 1, 1, True, r=r, ar=ar,
+                                  br=br)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               rtol=0.05, atol=0.05)

@@ -455,3 +455,73 @@ def test_native_recordio_writer_interop(tmp_path):
         got_c.append(rec)
     nr.close()
     assert got_c == recs
+
+
+def test_env_vars_doc_covers_v3_conv_knobs():
+    """The v3 epilogue/stride-2 knobs must be registered (and therefore
+    documented)."""
+    from incubator_mxnet_tpu.config import config, generate_env_vars_md
+
+    md = generate_env_vars_md()
+    for name in ("MXTPU_CONV_EPILOGUE", "MXTPU_CONV_STRIDE2"):
+        assert f"| `{name}` |" in md, name
+        assert name in config._knobs
+
+
+def test_telemetry_report_flags_dispatch_regression(tmp_path):
+    """ISSUE 11 guard: --compare must flag any workload whose bench-row
+    dispatches_per_step GREW vs the previous round (the signature of the
+    superstep wiring silently falling back to eager dispatch), and stay
+    quiet when it shrank."""
+    import tools.telemetry_report as rep
+
+    def write(path, dps):
+        with open(path, "w") as f:
+            for metric, d in dps.items():
+                f.write(json.dumps({
+                    "kind": "bench", "metric": metric, "value": 100.0,
+                    "unit": "images/sec/chip",
+                    "dispatches_per_step": d}) + "\n")
+        return str(path)
+
+    a = write(tmp_path / "a.jsonl",
+              {"resnet50_v1_train_throughput_per_chip": 0.04,
+               "ssd300_train_throughput_per_chip": 0.04})
+    b = write(tmp_path / "b.jsonl",
+              {"resnet50_v1_train_throughput_per_chip": 1.0,   # regressed
+               "ssd300_train_throughput_per_chip": 0.034})     # improved
+    out = rep.compare(a, b)
+    assert "dispatches_per_step grew on 1 metric(s)" in out
+    assert "resnet50_v1_train_throughput_per_chip/dispatches_per_step" \
+        in out.split("!!", 1)[1]
+    # the improved workload is not flagged
+    flagged = [l for l in out.splitlines() if l.startswith("!!   ")]
+    assert len(flagged) == 1
+
+    # no regression (identical runs) -> no flag block at all
+    out_ok = rep.compare(a, a)
+    assert "grew" not in out_ok
+
+
+def test_telemetry_report_shows_decision_record(tmp_path):
+    """part_d's kind:"decision" JSONL record surfaces in the summary and
+    its ratio is a comparable metric."""
+    import tools.telemetry_report as rep
+
+    sink = tmp_path / "run.jsonl"
+    with open(sink, "w") as f:
+        f.write(json.dumps({
+            "kind": "decision", "metric": "resnet_decision_part_d",
+            "ratio": 0.97, "threshold": 0.95, "winner": "fused",
+            "epilogue": "auto", "conv_bwd": "auto",
+            "stride2": "auto"}) + "\n")
+        f.write(json.dumps({
+            "kind": "bench", "metric": "resnet50_v1_train_throughput",
+            "value": 2490.7, "unit": "images/sec/chip",
+            "dispatches_per_step": 0.04}) + "\n")
+    out = rep.summarize(str(sink))
+    assert "decision resnet_decision_part_d" in out
+    assert "winner=fused" in out and "ratio=0.970" in out
+    assert "0.040" in out  # bench disp/step column
+    metrics = rep._comparable_metrics(rep._read(str(sink)))
+    assert metrics["decision/resnet_decision_part_d/ratio"] == 0.97

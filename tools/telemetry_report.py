@@ -228,11 +228,24 @@ def summarize(path: str, merge: bool = False) -> str:
     bench = [r for r in records if r.get("kind") == "bench"]
     if bench:
         lines.append("")
-        lines.append(f"{'bench metric':44s} {'value':>12s} {'unit':>18s}")
+        lines.append(f"{'bench metric':44s} {'value':>12s} {'unit':>18s} "
+                     f"{'disp/step':>10s}")
         for r in bench:
+            dps = r.get("dispatches_per_step")
             lines.append(f"{str(r.get('metric', '?')):44s} "
                          f"{r.get('value', 0):12.2f} "
-                         f"{str(r.get('unit', '')):>18s}")
+                         f"{str(r.get('unit', '')):>18s} "
+                         f"{(f'{dps:.3f}' if isinstance(dps, (int, float)) else '-'):>10s}")
+    for r in records:
+        if r.get("kind") == "decision":
+            lines.append("")
+            lines.append(
+                f"decision {r.get('metric', '?')}: winner="
+                f"{r.get('winner', '?')} ratio={r.get('ratio', 0):.3f} "
+                f"(threshold {r.get('threshold', 0):.2f}) "
+                f"epilogue={r.get('epilogue', '?')} "
+                f"bwd={r.get('conv_bwd', '?')} "
+                f"stride2={r.get('stride2', '?')}")
     return "\n".join(lines)
 
 
@@ -246,6 +259,16 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
             out[f"bench/{r['metric']}"] = float(r["value"])
             if isinstance(r.get("mfu_pct"), (int, float)):
                 out[f"bench/{r['metric']}/mfu_pct"] = float(r["mfu_pct"])
+            # per-workload dispatch regression key (ISSUE 11): compare()
+            # flags any workload whose disp/step GREW vs the baseline
+            # run — the superstep wiring silently falling back to eager
+            # looks exactly like 1/K -> 1.0 here
+            if isinstance(r.get("dispatches_per_step"), (int, float)):
+                out[f"bench/{r['metric']}/dispatches_per_step"] = \
+                    float(r["dispatches_per_step"])
+        if r.get("kind") == "decision" and "metric" in r \
+                and isinstance(r.get("ratio"), (int, float)):
+            out[f"decision/{r['metric']}/ratio"] = float(r["ratio"])
     for site, steps in _group_steps(records).items():
         # superstep-normalized per-step samples (see _step_walls): a
         # --compare of a K>1 run against a pre-superstep run diffs
@@ -306,6 +329,7 @@ def compare(path_a: str, path_b: str, merge: bool = False) -> str:
     lines = [f"telemetry compare — A={path_a}  B={path_b}",
              "",
              f"{'metric':44s} {'A':>12s} {'B':>12s} {'delta':>9s}"]
+    disp_regressions = []
     for k in keys:
         va, vb = a.get(k), b.get(k)
         if va is None or vb is None:
@@ -318,7 +342,22 @@ def compare(path_a: str, path_b: str, merge: bool = False) -> str:
             delta = f"{100.0 * (vb - va) / abs(va):+8.1f}%"
         else:
             delta = "   n/a" if vb == 0 else "   new"
-        lines.append(f"{k:44s} {va:12.3f} {vb:12.3f} {delta:>9s}")
+        flag = ""
+        if "dispatches_per_step" in k and vb > va * 1.05 + 1e-9:
+            flag = "  !!"
+            disp_regressions.append((k, va, vb))
+        lines.append(f"{k:44s} {va:12.3f} {vb:12.3f} {delta:>9s}{flag}")
+    if disp_regressions:
+        # the superstep-wiring guard (ISSUE 11): a workload whose
+        # dispatches/step GREW between rounds means the K-steps-per-
+        # dispatch engine silently fell back to per-step eager dispatch
+        # (knob off, engine fallback, or a bench row regression)
+        lines.append("")
+        lines.append(f"!! dispatches_per_step grew on "
+                     f"{len(disp_regressions)} metric(s) — superstep "
+                     f"fell back to eager dispatch?")
+        for k, va, vb in disp_regressions:
+            lines.append(f"!!   {k}: {va:.3f} -> {vb:.3f}")
     return "\n".join(lines)
 
 
