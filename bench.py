@@ -1,4 +1,5 @@
-"""Benchmarks: all five BASELINE.json configs, one JSON line each.
+"""Benchmarks: the BASELINE.json configs plus the added workloads, one
+JSON line each.
 
 Every config runs the fused SPMD training path (forward + backward +
 optimizer in one XLA computation, bf16 compute) on whatever devices are
@@ -38,6 +39,10 @@ ANCHORS = {
     "lstm_ptb": 20_000.0,
     "bert_base": 220.0,
     "ssd300": 180.0,
+    # GPT-2-small-class decoder LM pretraining, ~25k tokens/s/A100 AMP
+    # (memory anchor ◊, unverified — same caveat as every anchor here);
+    # the sixth workload (ISSUE 12): the training half of the decode tier
+    "gpt_decoder": 25_000.0,
     # speedup of the DevicePrefetcher feed over the synchronous feed
     # with a synthetic-slow host source (benchmark/data_bench.py);
     # anchor 1.0 = no overlap, so vs_baseline IS the speedup
@@ -478,6 +483,58 @@ def bench_bert():
             _tfs(trainer, ([tok, seg, vl], [mlm_y, nsp_y]), per, n_dev))
 
 
+def bench_gpt():
+    """The sixth workload (ISSUE 12): GPT-decoder causal-LM pretraining
+    (117M-class: 12x768x12, seq 256, bf16) through the same fused SPMD
+    stack as every other row — attention via the size-dispatched
+    ``flash_attention`` op, superstep when ``MXTPU_SUPERSTEP`` engages.
+    The serving half of this config is measured by
+    ``benchmark/decode_bench.py`` (continuous batching vs naive
+    re-prefill)."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import get_gpt
+
+    n_dev = len(jax.devices())
+    B, T, V = 8 * n_dev, 256, 50257
+    net = get_gpt("gpt_decoder_117m", vocab_size=V, dropout=0.0,
+                  max_length=T)
+    net.initialize(init="xavier")
+    net.cast("bfloat16")
+    net(mx.nd.zeros((2, T), dtype="int32"))
+
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return ce(logits, labels).mean()
+
+    mesh = parallel.make_mesh({"data": -1})
+    trainer = parallel.SPMDTrainer(
+        net, lm_loss, "sgd", {"learning_rate": 1e-4, "momentum": 0.9},
+        mesh=mesh)
+
+    def batch_fn(i):
+        rs = np.random.RandomState(i)
+        return (rs.randint(0, V, (B, T)).astype(np.int32),
+                rs.randint(0, V, (B, T)).astype(np.float32))
+
+    bx, by = batch_fn(0)
+    tok = _place(mesh, bx)
+    y = _place(mesh, by)
+    if _superstep_on():
+        per = _superstep_fit(trainer, batch_fn, [None, None])
+        mode, sk = "ondevice", [ITERS, ITERS2]
+    else:
+        per = _timed_steps(trainer, (tok, y))
+        mode, sk = "dispatch", None
+    _row_extra(trainer, (tok, y), per, mode, superstep_k=sk)
+    return (B * T / per / n_dev, "tokens/sec/chip",
+            "gpt_decoder_pretrain_throughput_per_chip", "gpt_decoder",
+            _tfs(trainer, (tok, y), per, n_dev))
+
+
 def bench_ssd():
     """config[4]: SSD-300 VOC with AMP (bf16 tower) — target assignment
     (multibox_target) fused into the jitted step.
@@ -751,6 +808,7 @@ CONFIGS = {
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert,
     "ssd300": bench_ssd,
+    "gpt_decoder": bench_gpt,
     "data_pipeline": bench_data_pipeline,
     "resilience": bench_resilience,
     "reshard": bench_reshard,

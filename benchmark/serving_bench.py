@@ -9,6 +9,16 @@ raise. Runs on whatever backend jax selects (CPU fallback included):
 
     python benchmark/serving_bench.py [--requests 512] [--clients 16] \
         [--in-dim 256] [--hidden 512] [--wait-ms 2.0]
+
+Open-loop sustained-traffic mode (ISSUE 12): a Poisson arrival process
+at each offered rate — arrivals do NOT wait for completions, so queueing
+delay is measured honestly (closed-loop clients self-throttle and hide
+it). One p99-latency-vs-offered-load point per rate, emitted as
+``kind:"serving"`` JSONL rows; :func:`open_loop` is the load harness
+``decode_bench.py`` shares::
+
+    python benchmark/serving_bench.py --open-loop --rates 50,100,200 \
+        --duration 5
 """
 
 from __future__ import annotations
@@ -24,6 +34,141 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def open_loop(fire, rate_rps: float, duration_s: float, seed: int = 0,
+              join_timeout: float = 120.0) -> dict:
+    """Open-loop (Poisson) load generator — the harness shared by batch
+    serving and decode serving.
+
+    ``fire(i)`` must START request ``i`` and return its resolver: any
+    object with Future-style ``add_done_callback(fn)`` +
+    ``exception(timeout)`` — a ``concurrent.futures.Future``
+    (ModelServer) or a ``serving.DecodeHandle``. Completion latency is
+    recorded from the resolver's own done-callback, NOT from a
+    per-request waiter thread: at 200 req/s x 5 s a thread per request
+    is ~1000 GIL-contending Python threads whose scheduler thrash would
+    inflate exactly the p99 this harness exists to measure.
+    Backpressure rejections must raise from ``fire`` itself
+    (``QueueFullError``); deadline sheds may surface from either side
+    (``DeadlineExceededError``). Returns offered/completed counts,
+    rejected/shed/error counts and the completed-request latency list.
+    """
+    from incubator_mxnet_tpu.serving import (DeadlineExceededError,
+                                             QueueFullError)
+
+    rs = np.random.RandomState(seed)
+    cv = threading.Condition()
+    lats, counts = [], {"rejected": 0, "shed": 0, "errors": 0}
+    outstanding = [0]
+
+    def record(obj, ts):
+        dt = time.perf_counter() - ts
+        try:
+            exc = obj.exception(0)         # done: never blocks
+        except Exception:                  # noqa: BLE001 — cancelled etc.
+            exc = RuntimeError("unresolved")
+        with cv:
+            if exc is None:
+                lats.append(dt)
+            elif isinstance(exc, DeadlineExceededError):
+                counts["shed"] += 1
+            else:
+                counts["errors"] += 1
+            outstanding[0] -= 1
+            cv.notify_all()
+
+    offered = 0
+    t0 = time.perf_counter()
+    next_t = rs.exponential(1.0 / rate_rps)
+    while True:
+        now = time.perf_counter() - t0
+        if now >= duration_s:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        next_t += rs.exponential(1.0 / rate_rps)
+        offered += 1
+        t_sub = time.perf_counter()
+        try:
+            obj = fire(offered - 1)
+        except QueueFullError:
+            counts["rejected"] += 1
+            continue
+        except DeadlineExceededError:
+            counts["shed"] += 1
+            continue
+        with cv:
+            outstanding[0] += 1
+        obj.add_done_callback(lambda o, ts=t_sub: record(o, ts))
+    deadline = time.perf_counter() + join_timeout
+    with cv:
+        while outstanding[0] > 0 and time.perf_counter() < deadline:
+            cv.wait(timeout=0.1)
+    wall = time.perf_counter() - t0
+    return {"offered": offered, "completed": len(lats),
+            "offered_rps": offered / duration_s,
+            "achieved_rps": len(lats) / wall, "lats": lats,
+            "duration_s": duration_s, **counts}
+
+
+def open_loop_row(model: str, rate: float, res: dict) -> dict:
+    """One ``kind:"serving"`` JSONL row per offered-rate point — shared
+    by the batch and decode benches so the row schema (and the --compare
+    key parity between the two curves) cannot drift. ``rate`` is the
+    NOMINAL requested rate and is what compare keys point at: the
+    measured Poisson ``offered_rps`` differs run to run, so exact-match
+    keys built from it would never line up across rounds."""
+    return {"kind": "serving", "mode": "open_loop", "model": model,
+            "rate": float(rate),
+            "offered_rps": round(res["offered_rps"], 2),
+            "achieved_rps": round(res["achieved_rps"], 2),
+            "p50_ms": round(pctl(res["lats"], 50) * 1e3, 3),
+            "p99_ms": round(pctl(res["lats"], 99) * 1e3, 3),
+            "completed": res["completed"], "rejected": res["rejected"],
+            "shed": res["shed"], "errors": res["errors"]}
+
+
+def emit_row(row: dict) -> None:
+    """Mirror a row into the telemetry JSONL sink; never let
+    observability break the benchmark."""
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.jsonl_emit(row)
+    except Exception:
+        pass
+
+
+def run_open_loop(net, xs, rates, duration, wait_ms, buckets,
+                  deadline_ms):
+    """One ModelServer per offered rate (clean queue state per point)."""
+    from incubator_mxnet_tpu import serving
+
+    rows = []
+    for idx, rate in enumerate(rates):
+        # one server (and one watchdog site) per rate point: a reused
+        # site name would let point N+1's warmup compiles be judged
+        # against point N's step ledger and flag false recompiles
+        srv = serving.ModelServer(net, buckets=buckets, max_wait_ms=wait_ms,
+                                  max_queue=4 * buckets[-1],
+                                  name=f"bench-r{idx}",
+                                  deadline_ms=deadline_ms or None)
+        try:
+            srv.warmup(xs.shape[1:], xs.dtype)
+
+            def fire(i):
+                return srv.submit(xs[i % len(xs)])
+
+            res = open_loop(fire, rate, duration)
+        finally:
+            srv.drain(10)
+            srv.close()
+        row = open_loop_row("bench", rate, res)
+        rows.append(row)
+        emit_row(row)
+    return rows
+
+
 def build_net(in_dim: int, hidden: int, out_dim: int):
     import incubator_mxnet_tpu as mx
 
@@ -37,6 +182,8 @@ def build_net(in_dim: int, hidden: int, out_dim: int):
 
 
 def pctl(vals, p):
+    if not vals:
+        return 0.0
     return sorted(vals)[min(len(vals) - 1, int(p / 100.0 * len(vals)))]
 
 
@@ -104,6 +251,16 @@ def main():
     ap.add_argument("--out-dim", type=int, default=64)
     ap.add_argument("--wait-ms", type=float, default=2.0)
     ap.add_argument("--buckets", type=str, default="1,2,4,8,16,32")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="sustained-traffic mode: Poisson arrivals at "
+                         "each --rates point, p99 vs offered load")
+    ap.add_argument("--rates", type=str, default="50,100,200",
+                    help="offered request rates (req/s) for --open-loop")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per offered-rate point in --open-loop")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request queue deadline in --open-loop "
+                         "(0 = no shedding)")
     args = ap.parse_args()
 
     import jax
@@ -112,6 +269,23 @@ def main():
     net = build_net(args.in_dim, args.hidden, args.out_dim)
     xs = np.random.RandomState(0).rand(
         args.requests, args.in_dim).astype(np.float32)
+
+    if args.open_loop:
+        rates = [float(r) for r in args.rates.split(",")]
+        rows = run_open_loop(net, xs, rates, args.duration, args.wait_ms,
+                             buckets, args.deadline_ms)
+        print(f"serving bench (open loop) — backend="
+              f"{jax.default_backend()} net={args.in_dim}x{args.hidden}"
+              f"x{args.out_dim} duration={args.duration}s "
+              f"deadline={args.deadline_ms}ms")
+        print(f"  {'offered rps':>12s} {'achieved rps':>13s} "
+              f"{'p50 ms':>9s} {'p99 ms':>9s} {'rejected':>9s} "
+              f"{'shed':>6s} {'errors':>7s}")
+        for r in rows:
+            print(f"  {r['offered_rps']:12.1f} {r['achieved_rps']:13.1f} "
+                  f"{r['p50_ms']:9.2f} {r['p99_ms']:9.2f} "
+                  f"{r['rejected']:9d} {r['shed']:6d} {r['errors']:7d}")
+        return
 
     uw, ul = run_unbatched(net, xs)
     sw, sl, stats = run_served(net, xs, args.clients, args.wait_ms, buckets)

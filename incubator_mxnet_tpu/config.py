@@ -388,6 +388,31 @@ config.register(
     "gradient magnitudes closer at more scale overhead (4 bytes per "
     "block on the wire). Must be a multiple of 4 for 2bit packing.")
 config.register(
+    "MXTPU_DECODE_SLOTS", 8, int,
+    "KV-cache slot count of a serving.DecodeSession (the continuous-"
+    "batching degree: how many sequences decode concurrently in the one "
+    "compiled decode executable). Sizes the device-resident cache as "
+    "slots x layers x heads x max_len x head_dim x 2.")
+config.register(
+    "MXTPU_DECODE_MAX_LEN", 512, int,
+    "Per-slot KV-cache capacity (tokens) of a serving.DecodeSession — "
+    "prompt plus generated tokens per sequence; clipped to the decoder's "
+    "max_length position table. A sequence that fills its slot finishes "
+    "(capacity exhaustion), it never recompiles.")
+config.register(
+    "MXTPU_DECODE_BUCKETS", "16,32,64,128,256", str,
+    "Prompt-LENGTH buckets for the prefill executor cache of a "
+    "serving.DecodeSession (comma-separated; entries above the cache "
+    "max_len are dropped). One AOT prefill executable + one cache-join "
+    "executable compiles per bucket at warmup; prompts pad up to their "
+    "bucket — the decode-tier analog of the batch-size buckets in "
+    "MXTPU serving (docs/SERVING.md).")
+config.register(
+    "MXTPU_DECODE_MAX_NEW_TOKENS", 128, int,
+    "Default generation budget per decode request (submit's "
+    "max_new_tokens overrides). Generation also stops at the request's "
+    "eos_id or at cache capacity.")
+config.register(
     "MXTPU_DEBUG_NANS", False, _parse_bool,
     "Debug mode: raise at the first NaN/Inf produced by any computation "
     "(jax_debug_nans) — the numeric-sanitizer analog of the reference's "

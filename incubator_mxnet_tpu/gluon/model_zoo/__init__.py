@@ -1,4 +1,6 @@
 """Model zoo (reference ``python/mxnet/gluon/model_zoo/``)."""
 
+from . import gpt
 from . import vision
+from .gpt import GPTDecoder, get_gpt
 from .vision import get_model

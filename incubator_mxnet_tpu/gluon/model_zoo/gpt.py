@@ -1,0 +1,304 @@
+"""GPT-style autoregressive decoder — the sixth workload (ISSUE 12).
+
+A pre-norm decoder-only transformer (GPT-2 convention: LayerNorm before
+attention/FFN, learned position embeddings, untied LM head) built from
+the same gluon blocks as the BERT encoder (``models/transformer.py``)
+but wired for BOTH halves of the decoder-LLM story:
+
+* **Training**: ``forward(tokens) -> logits`` is a plain causal
+  full-sequence pass; attention routes through ``flash_attention``
+  (size-dispatched: XLA dense below the measured Pallas crossover, the
+  streaming Pallas kernels above it), so the same config trains under
+  ``SPMDTrainer`` + SuperStep + the ZeRO ladder like every other
+  workload.
+* **Serving**: ``prefill`` additionally returns the per-layer K/V planes
+  so a serving tier can seed a device-resident KV cache, and
+  ``decode_step`` advances EVERY slot of a ``[L, S, H, T, D]`` cache by
+  one token — the new token's K/V is written at its slot's fill level
+  via a vmapped ``dynamic_update_slice`` and attention reads exactly
+  ``[0, cache_len]`` through the ``cache_offset`` flash-attention path
+  (ops/pallas_attention.py). Because every shape is static in
+  ``max_len``/slot count, ONE compiled decode executable serves any mix
+  of sequence ages with zero recompiles (serving/decode.py builds it).
+
+All three entry points share the same sub-blocks (one parameter set),
+so greedy decode through the cache is bit-exact against the
+full-sequence forward oracle — the contract tests/test_decode.py pins.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+
+__all__ = ["CausalSelfAttention", "GPTBlockCell", "GPTDecoder", "get_gpt"]
+
+
+def _positions_like(tokens):
+    """(B, T) int32 position ids 0..T-1 broadcast over the batch."""
+    import jax.numpy as jnp
+
+    from ...ndarray.ndarray import invoke
+
+    return invoke(
+        lambda x: jnp.broadcast_to(
+            jnp.arange(x.shape[1], dtype=jnp.int32), x.shape),
+        [tokens], name="positions", differentiable=False)
+
+
+def _stack0(arrays):
+    """Stack NDArrays along a new leading axis (per-layer cache planes)."""
+    import jax.numpy as jnp
+
+    from ...ndarray.ndarray import invoke
+
+    return invoke(lambda *xs: jnp.stack(xs, axis=0), arrays,
+                  name="stack_layers", differentiable=False)
+
+
+def _kv_cache_write(cache, new, total_lens):
+    """Write each slot's new K/V row at its fill position.
+
+    ``cache`` (S, H, T, D), ``new`` (S, H, 1, D), ``total_lens`` (S,)
+    valid length per slot INCLUDING the new token — the write lands at
+    ``total_lens - 1``. A vmapped ``dynamic_update_slice`` so the whole
+    batch updates in one fused op with per-slot indices; in the donated
+    decode executable XLA aliases input/output so this is an in-place
+    cache write, not a copy."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ...ndarray.ndarray import invoke
+
+    def write(c, u, lens):
+        idx = lens.astype(jnp.int32) - 1
+
+        def one(cs, us, i):
+            return lax.dynamic_update_slice(cs, us, (0, i, 0))
+
+        return jax.vmap(one)(c, u, idx)
+
+    return invoke(write, [cache, new, total_lens], name="kv_cache_write",
+                  differentiable=False)
+
+
+class CausalSelfAttention(HybridBlock):
+    """Fused-QKV multi-head causal self-attention with a decode mode."""
+
+    def __init__(self, units, num_heads, dropout=0.0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, in_units=units)
+            self.proj = Dense(units, flatten=False, in_units=units)
+            self.drop = Dropout(dropout)
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self._heads,
+                         self._units // self._heads).transpose((0, 2, 1, 3))
+
+    def _project(self, x):
+        c = self._units
+        qkv = self.qkv(x)
+        return (self._split(qkv.slice_axis(2, 0, c)),
+                self._split(qkv.slice_axis(2, c, 2 * c)),
+                self._split(qkv.slice_axis(2, 2 * c, 3 * c)))
+
+    def forward(self, x, *args):
+        out, _, _ = self.forward_with_kv(x)
+        return out
+
+    def forward_with_kv(self, x):
+        """Full-sequence causal attention; also returns this layer's K/V
+        planes (B, H, T, D) for cache seeding (prefill)."""
+        from ...ndarray.ndarray import invoke_op
+
+        q, k, v = self._project(x)
+        out = invoke_op("flash_attention", q, k, v, causal=True)
+        b, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape(b, t, self._units)
+        return self.drop(self.proj(out)), k, v
+
+    def decode_step(self, x, k_cache, v_cache, total_lens):
+        """One-token decode over this layer's cache plane.
+
+        ``x`` (S, 1, C) — the new token's activations per slot;
+        ``k_cache``/``v_cache`` (S, H, T, D); ``total_lens`` (S,) valid
+        length per slot including the new token. Returns the attended
+        activations and the UPDATED cache planes (new K/V written at
+        ``total_lens - 1``; attention reads ``[0, total_lens)`` exactly
+        via the ``cache_offset`` path)."""
+        from ...ndarray.ndarray import invoke_op
+
+        q, k_new, v_new = self._project(x)
+        k_cache = _kv_cache_write(k_cache, k_new, total_lens)
+        v_cache = _kv_cache_write(v_cache, v_new, total_lens)
+        out = invoke_op("flash_attention", q, k_cache, v_cache, total_lens,
+                        cache_offset=True)
+        s, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape(s, t, self._units)
+        return self.drop(self.proj(out)), k_cache, v_cache
+
+
+class GPTBlockCell(HybridBlock):
+    """Pre-norm decoder block: x + attn(ln1(x)); x + ffn(ln2(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units)
+            self.attn = CausalSelfAttention(units, num_heads,
+                                            dropout=dropout)
+            self.ln2 = LayerNorm(in_channels=units)
+            self.ffn1 = Dense(hidden_size, flatten=False, in_units=units)
+            self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
+            self.ffn_drop = Dropout(dropout)
+
+    def _ffn(self, x):
+        from ... import ndarray as F
+
+        return self.ffn_drop(self.ffn2(F.Activation(self.ffn1(x),
+                                                    act_type="gelu")))
+
+    def forward(self, x, *args):
+        x = x + self.attn(self.ln1(x))
+        return x + self._ffn(self.ln2(x))
+
+    def forward_with_kv(self, x):
+        a, k, v = self.attn.forward_with_kv(self.ln1(x))
+        x = x + a
+        return x + self._ffn(self.ln2(x)), k, v
+
+    def decode_step(self, x, k_cache, v_cache, total_lens):
+        a, k_cache, v_cache = self.attn.decode_step(
+            self.ln1(x), k_cache, v_cache, total_lens)
+        x = x + a
+        return x + self._ffn(self.ln2(x)), k_cache, v_cache
+
+
+class GPTDecoder(HybridBlock):
+    """GPT-style decoder LM: tokens (B, T) int32 -> logits (B, T, V).
+
+    ``max_length`` bounds both the training sequence length and the
+    serving KV-cache ``max_len`` (learned position table size)."""
+
+    def __init__(self, vocab_size=50257, units=768, hidden_size=None,
+                 num_layers=12, num_heads=12, max_length=1024, dropout=0.1,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._vocab = vocab_size
+        self._units = units
+        self._layers = num_layers
+        self._heads = num_heads
+        self._max_length = max_length
+        hidden_size = 4 * units if hidden_size is None else hidden_size
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units)
+            self.position_embed = Embedding(max_length, units)
+            self.embed_dropout = Dropout(dropout)
+            for i in range(num_layers):
+                setattr(self, f"layer{i}",
+                        GPTBlockCell(units, hidden_size, num_heads,
+                                     dropout=dropout))
+            self.ln_f = LayerNorm(in_channels=units)
+            self.head = Dense(vocab_size, flatten=False, use_bias=False,
+                              in_units=units)
+
+    # serving/decode.py sizes the KV cache off these
+    @property
+    def num_layers(self):
+        return self._layers
+
+    @property
+    def num_heads(self):
+        return self._heads
+
+    @property
+    def head_dim(self):
+        return self._units // self._heads
+
+    @property
+    def max_length(self):
+        return self._max_length
+
+    @property
+    def vocab_size(self):
+        return self._vocab
+
+    def _embed(self, tokens, positions):
+        return self.embed_dropout(self.word_embed(tokens)
+                                  + self.position_embed(positions))
+
+    def forward(self, tokens, *args):
+        x = self._embed(tokens, _positions_like(tokens))
+        for i in range(self._layers):
+            x = getattr(self, f"layer{i}")(x)
+        return self.head(self.ln_f(x))
+
+    def prefill(self, tokens):
+        """Full causal forward that ALSO returns the per-layer K/V planes
+        for cache seeding: ``logits`` (B, T, V), ``k``/``v``
+        (L, B, H, T, D). Positions beyond a prompt's true length carry
+        garbage K/V — causality guarantees no valid position ever
+        attended them, and the serving tier's per-slot ``cache_len``
+        keeps decode from reading them."""
+        x = self._embed(tokens, _positions_like(tokens))
+        ks, vs = [], []
+        for i in range(self._layers):
+            x, k, v = getattr(self, f"layer{i}").forward_with_kv(x)
+            ks.append(k)
+            vs.append(v)
+        return self.head(self.ln_f(x)), _stack0(ks), _stack0(vs)
+
+    def decode_step(self, tokens, k_cache, v_cache, cache_len):
+        """Advance every slot one token: ``tokens`` (S,) int32 — the next
+        input token per slot; ``k_cache``/``v_cache`` (L, S, H, T, D);
+        ``cache_len`` (S,) tokens already cached per slot (the new token
+        lands at that position). Returns ``logits`` (S, V) and the
+        updated caches. Slots whose entries are stale (free slots) still
+        compute — the scheduler ignores their rows; their writes land in
+        freed cache space."""
+        s = tokens.shape[0]
+        tok = tokens.reshape(s, 1)
+        pos = cache_len.reshape(s, 1)
+        x = self._embed(tok, pos)
+        total = cache_len + 1
+        new_k, new_v = [], []
+        for i in range(self._layers):
+            k_l = k_cache.slice_axis(0, i, i + 1).squeeze(0)
+            v_l = v_cache.slice_axis(0, i, i + 1).squeeze(0)
+            x, k_l, v_l = getattr(self, f"layer{i}").decode_step(
+                x, k_l, v_l, total)
+            new_k.append(k_l)
+            new_v.append(v_l)
+        logits = self.head(self.ln_f(x)).squeeze(1)
+        return logits, _stack0(new_k), _stack0(new_v)
+
+
+#: GPT-2-family configs (117M/345M) plus a tiny config for tests/benches
+_GPT_SPECS = {
+    "gpt_decoder_tiny": dict(num_layers=2, units=64, num_heads=4),
+    "gpt_decoder_117m": dict(num_layers=12, units=768, num_heads=12),
+    "gpt_decoder_345m": dict(num_layers=24, units=1024, num_heads=16),
+}
+
+
+def get_gpt(model_name="gpt_decoder_117m", vocab_size=50257, dropout=0.1,
+            max_length=1024, **kwargs):
+    """GPT decoder factory (the ``get_bert`` analog for the decoder
+    workload)."""
+    if model_name not in _GPT_SPECS:
+        raise ValueError(f"unknown gpt spec {model_name!r}; "
+                         f"known {sorted(_GPT_SPECS)}")
+    spec = dict(_GPT_SPECS[model_name])
+    spec.update(kwargs)
+    return GPTDecoder(vocab_size=vocab_size, dropout=dropout,
+                      max_length=max_length, **spec)

@@ -53,7 +53,7 @@ def pallas_available() -> bool:
 
 def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref=None,
                       *, bq, bk, t_k, t_valid, tq_valid, scale, causal,
-                      n_heads):
+                      n_heads, cache_offset=False):
     from jax import lax
 
     qi = q_ref[0]                                # native dtype: bf16 stays
@@ -75,8 +75,12 @@ def _flash_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref=None,
 
     nblocks = t_k // bk
     # bottom-right causal alignment, matching the XLA reference
-    # tril(k = tk - tq): col <= row + (tk - tq)
-    diag_off = t_valid - tq_valid
+    # tril(k = tk - tq): col <= row + (tk - tq). The cache-offset path
+    # (KV-cache decode: K/V are a [0, klen) prefix of a max_len buffer)
+    # aligns the diagonal to the PER-SAMPLE valid length instead of the
+    # static buffer end: query row i sits at absolute position
+    # klen - tq + i and attends keys [0, klen - tq + i] exactly.
+    diag_off = (klen - tq_valid) if cache_offset else (t_valid - tq_valid)
 
     def body(j, carry):
         m, l, acc = carry
@@ -140,7 +144,7 @@ def _pl():
 
 
 def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512,
-               return_lse=False):
+               return_lse=False, cache_offset=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -166,7 +170,7 @@ def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512,
 
     kernel = functools.partial(
         _flash_fwd_kernel, bq=bq, bk=bk, t_k=tkp, t_valid=tk, tq_valid=tq,
-        scale=scale, causal=causal, n_heads=h)
+        scale=scale, causal=causal, n_heads=h, cache_offset=cache_offset)
     in_specs = [
         pl.BlockSpec((b,), lambda bi, i: (0,),
                      memory_space=pltpu.SMEM),
@@ -204,7 +208,8 @@ def _flash_fwd(q, k, v, lengths, scale, causal, interpret, bq=256, bk=512,
 
 def _flash_bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
                          delta_ref, dq_ref, *, bq, bk, t_k, t_valid,
-                         tq_valid, scale, causal, n_heads):
+                         tq_valid, scale, causal, n_heads,
+                         cache_offset=False):
     """dQ = sum_j dS_j @ K_j, streaming KV blocks through VMEM.
 
     P is recomputed per block from the saved row log-sum-exp (no score
@@ -224,7 +229,7 @@ def _flash_bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
     prec = (jax.lax.Precision.DEFAULT
             if qi.dtype in (jnp.bfloat16, jnp.float16)
             else jax.lax.Precision.HIGHEST)
-    diag_off = t_valid - tq_valid
+    diag_off = (klen - tq_valid) if cache_offset else (t_valid - tq_valid)
     rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     finite = jnp.isfinite(lse)[:, None]
     lse_safe = jnp.where(finite, lse[:, None], 0.0)
@@ -261,7 +266,8 @@ def _flash_bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
 
 def _flash_bwd_dkv_kernel(len_ref, k_ref, v_ref, q_ref, g_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, *, bq, bk, t_valid,
-                          tq_valid, scale, causal, n_heads):
+                          tq_valid, scale, causal, n_heads,
+                          cache_offset=False):
     """dK = sum_i dS_i^T @ Q_i and dV = sum_i P_i^T @ dO_i.
 
     3-D grid (bh, kv block j, q block i) with i innermost: each program
@@ -281,7 +287,7 @@ def _flash_bwd_dkv_kernel(len_ref, k_ref, v_ref, q_ref, g_ref, lse_ref,
     prec = (jax.lax.Precision.DEFAULT
             if kj.dtype in (jnp.bfloat16, jnp.float16)
             else jax.lax.Precision.HIGHEST)
-    diag_off = t_valid - tq_valid
+    diag_off = (klen - tq_valid) if cache_offset else (t_valid - tq_valid)
     cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     valid = cols < jnp.minimum(t_valid, klen)
 
@@ -330,7 +336,7 @@ def _flash_bwd_dkv_kernel(len_ref, k_ref, v_ref, q_ref, g_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, lens, lse, delta, g, scale, causal, interpret,
-               bq=256, bk=256):
+               bq=256, bk=256, cache_offset=False):
     """Streaming flash backward: returns (dq, dk, dv) in the input dtypes.
 
     ``lse``/``delta`` are (B, H, Tq) fp32 row statistics from the forward
@@ -370,7 +376,7 @@ def _flash_bwd(q, k, v, lens, lse, delta, g, scale, causal, interpret,
                 else lens.astype(jnp.int32))
 
     common = dict(bq=bq, bk=bk, t_valid=tk, tq_valid=tq, scale=scale,
-                  causal=causal, n_heads=h)
+                  causal=causal, n_heads=h, cache_offset=cache_offset)
     len_spec = pl.BlockSpec((b,), lambda bi, i: (0,),
                             memory_space=pltpu.SMEM)
     q_blk = pl.BlockSpec((1, bq, d), lambda bi, i: (bi, i, 0))
@@ -416,10 +422,20 @@ def _flash_bwd(q, k, v, lens, lse, delta, g, scale, causal, interpret,
     return dq, dk, dv
 
 
-def _xla_reference(q, k, v, lengths, scale, causal):
+def _xla_reference(q, k, v, lengths, scale, causal, cache_offset=False):
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     tq, tk = scores.shape[-2], scores.shape[-1]
-    if causal:
+    if causal and cache_offset:
+        # diagonal aligned to the per-sample valid length (KV-cache
+        # decode): query row i is at absolute position l_b - tq + i and
+        # attends keys [0, l_b - tq + i]; the lengths mask below bounds
+        # the buffer tail
+        rows = jnp.arange(tq)[None, :, None]
+        cols = jnp.arange(tk)[None, None, :]
+        off = (lengths.astype(jnp.int32) - tq)[:, None, None]
+        cm = cols <= rows + off                        # (B, Tq, Tk)
+        scores = jnp.where(cm[:, None, :, :], scores, -jnp.inf)
+    elif causal:
         cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         scores = jnp.where(cm, scores, -jnp.inf)
     if lengths is not None:
@@ -430,23 +446,27 @@ def _xla_reference(q, k, v, lengths, scale, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, lens, scale, causal, interpret):
-    return _flash_fwd(q, k, v, lens, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, lens, scale, causal, interpret,
+                cache_offset=False):
+    return _flash_fwd(q, k, v, lens, scale, causal, interpret,
+                      cache_offset=cache_offset)
 
 
-def _flash_core_fwd(q, k, v, lens, scale, causal, interpret):
+def _flash_core_fwd(q, k, v, lens, scale, causal, interpret,
+                    cache_offset=False):
     out, lse = _flash_fwd(q, k, v, lens, scale, causal, interpret,
-                          return_lse=True)
+                          return_lse=True, cache_offset=cache_offset)
     return out, (q, k, v, lens, out, lse)
 
 
-def _flash_core_bwd(scale, causal, interpret, res, g):
+def _flash_core_bwd(scale, causal, interpret, cache_offset, res, g):
     q, k, v, lens, out, lse = res
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     dq, dk, dv = _flash_bwd(q, k, v, lens, lse, delta, g.astype(q.dtype),
-                            scale, causal, interpret)
+                            scale, causal, interpret,
+                            cache_offset=cache_offset)
     lens_ct = None if lens is None else \
         np.zeros(lens.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, lens_ct
@@ -478,10 +498,17 @@ def _use_pallas_path(b, h, tq, tk, interpret):
 
 @register("flash_attention")
 def flash_attention(q, k, v, lengths=None, scale=None, causal=False,
-                    interpret=None):
+                    interpret=None, cache_offset=False):
     """Block-tiled flash attention. q, k, v: (B, H, T, D); ``lengths``
     (B,) optional per-sample valid key length. The TPU analog of a
     hand-written fused attention CUDA kernel; see module docstring.
+
+    ``cache_offset=True`` is the KV-cache decode alignment (ISSUE 12):
+    K/V are the ``[0, lengths_b)`` prefix of a fixed ``max_len`` buffer
+    and the Tq query tokens are the LAST tq of that prefix — query row i
+    sits at absolute position ``lengths_b - tq + i`` and attends keys
+    ``[0, lengths_b - tq + i]`` exactly (decode step t attends [0, t]).
+    Requires ``lengths`` with every entry >= Tq; implies ``causal``.
 
     Dispatch: below the measured Pallas crossover (``MXTPU_FLASH_MIN_SEQ``)
     the mathematically identical XLA dense path runs instead — same
@@ -489,9 +516,16 @@ def flash_attention(q, k, v, lengths=None, scale=None, causal=False,
     registry picks an algo per shape."""
     d = q.shape[-1]
     s = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if cache_offset:
+        if lengths is None:
+            raise ValueError("cache_offset=True requires per-sample "
+                             "lengths (the cache fill per slot)")
+        causal = True
     if not _use_pallas_path(q.shape[0], q.shape[1], q.shape[2],
                             k.shape[2], interpret):
-        return _xla_reference(q, k, v, lengths, s, bool(causal))
+        return _xla_reference(q, k, v, lengths, s, bool(causal),
+                              cache_offset=bool(cache_offset))
     if interpret is None:
         interpret = not pallas_available()
-    return _flash_core(q, k, v, lengths, s, bool(causal), bool(interpret))
+    return _flash_core(q, k, v, lengths, s, bool(causal), bool(interpret),
+                       bool(cache_offset))

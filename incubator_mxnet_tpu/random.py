@@ -67,6 +67,37 @@ class key_provider:
         _rs.providers.pop()
 
 
+class inference_key_provider:
+    """``next_key()`` source for inference-mode AOT tracing (the serving
+    executor caches): hands back ONE key materialized at CONSTRUCTION
+    time, performing ZERO jax ops inside the trace.
+
+    Why it exists (ISSUE 12): ``needs_rng`` ops (Dropout) draw a key at
+    invoke time even when ``training=False`` leaves it unused. Under an
+    AOT ``jit(...).lower()`` trace the default ``next_key()`` stages
+    ``random_wrap/fold_in/unwrap`` ops on the thread-local root key —
+    dead code, but the staged ops hoist the root key into the lowered
+    computation as a closure-const INPUT, and the compiled executable's
+    call signature then disagrees with the caller's operand list
+    ("compiled for N+1 inputs but called with N"). A pre-materialized
+    constant key stages nothing; if a model ever consumed randomness in
+    inference mode it would bake this fixed key (deterministic serving,
+    which is the contract anyway)."""
+
+    def __init__(self):
+        self._key = jax.random.PRNGKey(0)
+
+    def __call__(self):
+        return self._key
+
+    def __enter__(self):
+        _rs.providers.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _rs.providers.pop()
+
+
 def seed(seed_state: int, ctx: str = "all") -> None:
     """Seed the global generator (reference ``mx.random.seed``).
 

@@ -17,17 +17,26 @@ the C predict API, and MXNet Model Server, rebuilt TPU-native:
   readiness for a routing front door.
 * ``ServingMetrics`` — latency percentiles, queue depth, batch
   occupancy, cache hit/miss — also published into profiler traces.
+* ``DecodeSession`` — the autoregressive front door (ISSUE 12):
+  KV-cache-resident decode with continuous batching over a slot cache;
+  prefill per length bucket, ONE donated decode executable, sequences
+  join/leave at step boundaries with zero recompiles; tokens stream
+  through ``DecodeHandle``; ``DecodeMetrics`` is its ``mxtpu_decode_*``
+  telemetry family (docs/SERVING.md "Continuous batching").
 """
 
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       ServerClosedError)
+from .decode import DecodeHandle, DecodeSession, KVCache
 from .executor_cache import (DEFAULT_BUCKETS, BucketedExecutorCache,
-                             block_apply_fn)
-from .metrics import ServingMetrics
-from .server import ModelServer
+                             block_apply_fn, pure_method_runner)
+from .metrics import DecodeMetrics, ServingMetrics
+from .server import ModelServer, load_block_checkpoint
 
 __all__ = [
     "BucketedExecutorCache", "DEFAULT_BUCKETS", "DeadlineExceededError",
-    "DynamicBatcher", "ModelServer", "QueueFullError", "ServerClosedError",
-    "ServingMetrics", "block_apply_fn",
+    "DecodeHandle", "DecodeMetrics", "DecodeSession", "DynamicBatcher",
+    "KVCache", "ModelServer", "QueueFullError", "ServerClosedError",
+    "ServingMetrics", "block_apply_fn", "load_block_checkpoint",
+    "pure_method_runner",
 ]

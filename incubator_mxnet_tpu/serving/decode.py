@@ -1,0 +1,773 @@
+"""KV-cache-resident autoregressive decode with continuous batching.
+
+The serving half of the decoder-LLM workload (ISSUE 12): a
+**prefill/decode split** over a slot-based, device-resident KV cache
+``[layers, slots, heads, max_len, head_dim]``, in the full-AOT stance of
+arXiv:1810.09868 / arXiv:1605.08695 — a small FIXED set of pre-compiled
+executables with ALL dynamism carried as device-resident state or tiny
+per-step host vectors, never as recompilation:
+
+* **Prefill** compiles once per prompt-LENGTH bucket through the PR 1
+  ``BucketedExecutorCache`` (token axis leading, ``pass_count`` so the
+  true prompt length reaches the graph as a traced scalar): one causal
+  forward over the padded prompt returning the greedy first token and
+  the per-layer K/V planes.
+* **Join** (one tiny executable per bucket) writes a prefilled plane
+  into a slot's cache range with ``lax.dynamic_update_slice`` at a
+  TRACED slot index — any free slot, no recompile — donating the cache
+  so the write aliases in place.
+* **Decode** is ONE donated executable over the whole cache: every
+  step advances EVERY slot one token; per-slot ``cache_len`` (a host
+  int32 vector, H2D per step) makes the single program serve any mix
+  of sequence ages — flash attention reads exactly ``[0, cache_len)``
+  per slot via the ``cache_offset`` path.
+
+**Continuous batching**: new sequences join the running batch at step
+boundaries (the scheduler assigns free slots and prefills between decode
+steps), finished sequences free their slot without disturbing
+neighbours. The scheduler mirrors ``cache_len``/active state on the
+host — it is fully determined by its own actions, so the only per-step
+device→host traffic is the ``[slots]`` next-token vector the clients
+need anyway.
+
+Front-door semantics mirror :class:`~.server.ModelServer`: bounded-queue
+backpressure (``QueueFullError.retry_after``), per-request
+``deadline_ms`` shedding while queued, ``drain``/``close``/``healthz``;
+tokens stream out per step through :class:`DecodeHandle`.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import profiler
+from .. import telemetry
+from .batcher import (DeadlineExceededError, QueueFullError,
+                      ServerClosedError)
+from .executor_cache import (BucketedExecutorCache,
+                             pure_method_runner)
+from .metrics import DecodeMetrics, ServingMetrics
+
+__all__ = ["DecodeHandle", "DecodeSession", "KVCache"]
+
+logger = logging.getLogger("mxtpu.serving")
+
+
+def default_prefill_buckets(max_len: int) -> Tuple[int, ...]:
+    """Prompt-length buckets from ``MXTPU_DECODE_BUCKETS`` clipped to the
+    cache capacity (a bucket longer than ``max_len`` could never be
+    joined into a slot)."""
+    from ..config import config
+
+    raw = str(config.get("MXTPU_DECODE_BUCKETS"))
+    buckets = tuple(sorted({int(b) for b in raw.split(",") if b.strip()}))
+    clipped = tuple(b for b in buckets if b <= max_len)
+    if not clipped:
+        clipped = (max_len,)
+    return clipped
+
+
+class KVCache:
+    """Device-resident per-slot KV planes ``[L, S, H, T, D]`` (k and v).
+
+    Owned by a :class:`DecodeSession`; rebound on every donated
+    join/decode dispatch (XLA aliases the buffers in place on backends
+    with donation). Freed slots are not zeroed — their ranges are
+    overwritten by the next prefill and never read in between
+    (``cache_len`` guards every attention read)."""
+
+    def __init__(self, num_layers: int, slots: int, num_heads: int,
+                 max_len: int, head_dim: int, dtype="float32"):
+        self.shape = (int(num_layers), int(slots), int(num_heads),
+                      int(max_len), int(head_dim))
+        self.dtype = jnp.dtype(dtype)
+        self.k = jax.device_put(jnp.zeros(self.shape, self.dtype))
+        self.v = jax.device_put(jnp.zeros(self.shape, self.dtype))
+
+    @property
+    def slots(self) -> int:
+        return self.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.shape[3]
+
+    @property
+    def nbytes(self) -> int:
+        return 2 * int(np.prod(self.shape)) * self.dtype.itemsize
+
+
+_DONE = object()
+
+
+class DecodeHandle:
+    """Streaming result of one decode request.
+
+    Iterate to receive generated token ids as the session emits them
+    (one per decode step; the first arrives with prefill)::
+
+        for tok in handle:           # blocks per token
+            ...
+        toks = handle.result(30.0)   # or wait for the full list
+
+    Errors (shed deadline, closed server, failed step) surface from both
+    the iterator and ``result``."""
+
+    def __init__(self):
+        self._q: _queue.Queue = _queue.Queue()
+        self._done = threading.Event()
+        self._tokens: List[int] = []
+        self._exc: Optional[BaseException] = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: List = []
+
+    # -- session side -------------------------------------------------------
+    def _put(self, tok: int) -> None:
+        if self._done.is_set():
+            return
+        self._tokens.append(int(tok))
+        self._q.put(int(tok))
+
+    def _finish(self) -> None:
+        if not self._done.is_set():
+            self._done.set()
+            self._q.put(_DONE)
+            self._fire_callbacks()
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self._done.is_set():
+            self._exc = exc
+            self._done.set()
+            self._q.put(_DONE)
+            self._fire_callbacks()
+
+    def _fire_callbacks(self) -> None:
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:              # noqa: BLE001 — callbacks
+                pass                       # never break the scheduler
+
+    # -- client side --------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        item = self._q.get()
+        if item is _DONE:
+            self._q.put(_DONE)       # keep the stream terminal
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Future-style completion hook: ``fn(handle)`` runs when the
+        sequence finishes or fails (immediately if already done). Keep
+        callbacks tiny — they run on the scheduler thread. Gives
+        ``DecodeHandle`` the same completion surface as the batch tier's
+        ``concurrent.futures.Future``, so the open-loop load harness
+        drives both without per-request waiter threads."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def exception(self, timeout: Optional[float] = None):
+        """Future-style: block until done; the failure (or None)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("decode request not finished in time")
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the sequence finishes; the full generated-token
+        list (prompt not included)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("decode request not finished in time")
+        if self._exc is not None:
+            raise self._exc
+        return list(self._tokens)
+
+    @property
+    def tokens(self) -> List[int]:
+        """Tokens generated so far (live view; grows per step)."""
+        return list(self._tokens)
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "eos_id", "t_submit", "handle")
+
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 eos_id: Optional[int]):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.t_submit = time.monotonic()
+        self.handle = DecodeHandle()
+
+
+class _Active:
+    __slots__ = ("req", "generated", "t_admitted")
+
+    def __init__(self, req: _Request):
+        self.req = req
+        self.generated = 0
+        self.t_admitted = time.monotonic()
+
+
+
+
+class DecodeSession:
+    """Continuous-batching autoregressive serving over one decoder block.
+
+    ``block`` is a :class:`~..gluon.model_zoo.gpt.GPTDecoder`-shaped
+    gluon block (``prefill``/``decode_step``/``num_layers``/
+    ``num_heads``/``head_dim`` surface), parameters initialized. Greedy
+    decoding (argmax) — the contract that makes the output stream
+    bit-exact against the full-sequence forward oracle.
+
+    Usage::
+
+        sess = mx.serving.DecodeSession(net, max_slots=8, max_len=256)
+        sess.warmup()                      # compile the fixed executable set
+        h = sess.submit(prompt_ids, max_new_tokens=64, eos_id=0)
+        for tok in h:                      # streams one token per step
+            ...
+        sess.drain(); sess.close()
+    """
+
+    def __init__(self, block, max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 64, name: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 donate: Optional[bool] = None,
+                 max_new_tokens: Optional[int] = None):
+        from ..config import config
+
+        self.name = name or (getattr(block, "name", "") or "gpt")
+        if max_slots is None:
+            max_slots = int(config.get("MXTPU_DECODE_SLOTS"))
+        if max_len is None:
+            max_len = int(config.get("MXTPU_DECODE_MAX_LEN"))
+        block_max = int(getattr(block, "max_length", max_len))
+        if max_len > block_max:
+            max_len = block_max     # position table bounds the cache
+        if max_slots < 1 or max_len < 2:
+            raise ValueError(f"need max_slots >= 1 and max_len >= 2, got "
+                             f"{max_slots}/{max_len}")
+        if max_new_tokens is None:
+            max_new_tokens = int(config.get("MXTPU_DECODE_MAX_NEW_TOKENS"))
+        if deadline_ms is None:
+            deadline_ms = float(config.get("MXTPU_SERVING_DEADLINE_MS"))
+        self.max_len = int(max_len)
+        self.max_slots = int(max_slots)
+        self.max_queue = int(max_queue)
+        self.default_max_new = int(max_new_tokens)
+        self.deadline_ms = None if deadline_ms <= 0 else float(deadline_ms)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+
+        self._run, self._params = pure_method_runner(block)
+        self._block = block
+        buckets = tuple(prefill_buckets) if prefill_buckets is not None \
+            else default_prefill_buckets(self.max_len)
+        bad = [b for b in buckets if b > self.max_len]
+        if bad:
+            raise ValueError(f"prefill buckets {bad} exceed max_len="
+                             f"{self.max_len}")
+        self._prefill = BucketedExecutorCache(
+            self._prefill_apply, self._params, buckets=buckets,
+            donate=donate, name=f"{self.name}.prefill",
+            metrics=ServingMetrics(f"{self.name}.prefill"),
+            pass_count=True, depad=False)
+
+        dtype = self._params[0].dtype
+        self._kv = KVCache(block.num_layers, max_slots, block.num_heads,
+                           self.max_len, block.head_dim, dtype=dtype)
+        self.metrics = DecodeMetrics(self.name)
+        self.metrics.set_capacity(max_slots, self._kv.nbytes)
+        self._meter = telemetry.StepMeter(f"decode.{self.name}")
+        self._flops: Optional[float] = None
+
+        self._joins: dict = {}
+        self._dec_ex = None
+        self._compile_lock = threading.Lock()
+
+        # host mirrors of the device cache state — fully determined by
+        # scheduler actions, so they are inputs each step, never fetched
+        self._cache_len = np.zeros((max_slots,), np.int32)
+        self._tokens = np.zeros((max_slots,), np.int32)
+        self._slots: List[Optional[_Active]] = [None] * max_slots
+        self._free = deque(range(max_slots))
+        self._pending: deque = deque()
+        self._cv = threading.Condition()
+        self._state = "running"
+        self._worker = threading.Thread(
+            target=self._loop, name=f"mxtpu-decode-{self.name}",
+            daemon=True)
+        self._worker.start()
+        telemetry.maybe_start_http()
+
+    # -- construction from artifacts -----------------------------------------
+    @classmethod
+    def from_checkpoint(cls, block, params_path: str, ctx=None,
+                        use_native: Optional[bool] = None,
+                        **kwargs) -> "DecodeSession":
+        """Load ``params_path`` into ``block`` and serve decode from it.
+        Accepts everything :meth:`ModelServer.from_checkpoint` accepts —
+        native ``.params`` checkpoints and sharded training-checkpoint
+        manifests from ANY mesh (train multi-chip, decode single-chip,
+        no export step): the loaders are shared
+        (``server.load_block_checkpoint``)."""
+        from .server import load_block_checkpoint
+
+        load_block_checkpoint(block, params_path, ctx=ctx,
+                              use_native=use_native)
+        return cls(block, **kwargs)
+
+    # -- the compiled executable set -----------------------------------------
+    def _prefill_apply(self, pvals, tokens, n):
+        """(first greedy token, k/v planes [L, H, Lb, D]) of one padded
+        prompt; ``n`` is the TRUE prompt length (traced), so the greedy
+        read indexes the last valid position without a per-length
+        executable."""
+        logits, k, v = self._run(self._block.prefill, pvals, tokens[None])
+        last = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
+                                            keepdims=False)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return first, k[:, 0], v[:, 0]
+
+    def _decode_apply(self, pvals, k, v, cache_len, tokens):
+        logits, k2, v2 = self._run(self._block.decode_step, pvals, tokens,
+                                   k, v, cache_len)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k2, v2
+
+    def _join_exec(self, bucket: int):
+        """The per-bucket cache-join executable: writes a prefilled
+        ``[L, H, Lb, D]`` plane into slot ``slot``'s cache range at
+        position 0 (``dynamic_update_slice`` with a TRACED slot index —
+        one executable serves every slot). Cache operands are donated."""
+        ex = self._joins.get(bucket)
+        if ex is not None:
+            return ex
+        with self._compile_lock:
+            ex = self._joins.get(bucket)
+            if ex is not None:
+                return ex
+
+            def join(kc, vc, kp, vp, slot):
+                at = (0, slot, 0, 0, 0)
+                return (jax.lax.dynamic_update_slice(kc, kp[:, None], at),
+                        jax.lax.dynamic_update_slice(vc, vp[:, None], at))
+
+            l, s, h, t, d = self._kv.shape
+            cache = jax.ShapeDtypeStruct(self._kv.shape, self._kv.dtype)
+            plane = jax.ShapeDtypeStruct((l, h, bucket, d), self._kv.dtype)
+            slot = jax.ShapeDtypeStruct((), jnp.int32)
+            telemetry.note_cache_miss(f"decode.{self.name}",
+                                      detail=f"join bucket={bucket}")
+            with profiler.scope(f"decode::{self.name}::compile"):
+                jitted = jax.jit(join, donate_argnums=(0, 1)
+                                 if self._donate else ())
+                ex = jitted.lower(cache, cache, plane, plane,
+                                  slot).compile()
+            self._joins[bucket] = ex
+            return ex
+
+    def _decode_exec(self):
+        """THE decode executable — compiled once; serves every mix of
+        sequence ages and slot occupancies with zero recompiles."""
+        if self._dec_ex is not None:
+            return self._dec_ex
+        with self._compile_lock:
+            if self._dec_ex is not None:
+                return self._dec_ex
+            cache = jax.ShapeDtypeStruct(self._kv.shape, self._kv.dtype)
+            vec = jax.ShapeDtypeStruct((self.max_slots,), jnp.int32)
+            telemetry.note_cache_miss(f"decode.{self.name}",
+                                      detail="decode")
+            with profiler.scope(f"decode::{self.name}::compile"):
+                jitted = jax.jit(self._decode_apply,
+                                 donate_argnums=(1, 2)
+                                 if self._donate else ())
+                p_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
+                           for p in self._params]
+                self._dec_ex = jitted.lower(p_specs, cache, cache, vec,
+                                            vec).compile()
+            return self._dec_ex
+
+    def _decode_flops(self) -> Optional[float]:
+        """Cost-analysis FLOPs of the decode step (free — the executable
+        is already compiled) for the online MFU gauge."""
+        if self._flops is None:
+            self._flops = telemetry.flops_of_compiled(self._dec_ex) or 0.0
+        return self._flops or None
+
+    def decode_cost_analysis(self) -> Optional[float]:
+        """XLA cost-analysis FLOPs of ONE decode step (whole-cache; all
+        slots) — compiles the decode executable if needed. None where
+        the backend exposes no cost model."""
+        self._decode_exec()
+        return self._decode_flops()
+
+    def prefill_cost_analysis(self, bucket: int) -> Optional[float]:
+        """Cost-analysis FLOPs of one prefill at ``bucket`` tokens."""
+        return telemetry.flops_of_compiled(
+            self._prefill.executable(bucket, (), "int32"))
+
+    def warmup(self) -> None:
+        """Compile the ENTIRE executable set ahead of traffic: every
+        prefill bucket, every join, and the decode program. After this,
+        steady-state serving performs zero compiles — the recompile
+        contract tests/test_decode.py pins under the armed watchdog."""
+        for b in self._prefill.buckets:
+            self._prefill.executable(b, (), "int32")
+            self._join_exec(b)
+        self._decode_exec()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_id: Optional[int] = None) -> DecodeHandle:
+        """Enqueue one prompt (1-D int token ids). The sequence joins the
+        running batch at the next step boundary with a free slot; tokens
+        stream out through the returned handle (greedy; generation stops
+        at ``eos_id`` (delivered), ``max_new_tokens``, or cache
+        capacity). Raises ``QueueFullError`` (backpressure) /
+        ``ServerClosedError``."""
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        n = arr.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self._prefill.max_batch_size:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill bucket "
+                f"{self._prefill.max_batch_size}; raise prefill_buckets=")
+        if n >= self.max_len:
+            raise ValueError(f"prompt of {n} tokens leaves no cache room "
+                             f"(max_len={self.max_len})")
+        max_new = self.default_max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = _Request(arr, max_new, eos_id)
+        with self._cv:
+            if self._state != "running":
+                raise ServerClosedError(
+                    f"decode session is {self._state}; not accepting")
+            if len(self._pending) >= self.max_queue:
+                self.metrics.observe_reject()
+                raise QueueFullError(
+                    f"decode queue full ({self.max_queue} waiting)",
+                    retry_after=self._retry_after_locked())
+            self._pending.append(req)
+            self._cv.notify_all()
+        self.metrics.observe_submit()
+        return req.handle
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = 300.0) -> List[int]:
+        """Synchronous :meth:`submit` — the full generated-token list."""
+        return self.submit(prompt, max_new_tokens, eos_id).result(timeout)
+
+    def _retry_after_locked(self) -> float:
+        # a slot frees after ~max_new steps; estimate from the step EMA
+        ema = self._meter.ema_seconds or 0.01
+        waves = (len(self._pending) + self.max_slots - 1) \
+            // max(1, self.max_slots)
+        return max(0.01, waves * ema * max(1, self.default_max_new) * 0.25)
+
+    # -- scheduler ------------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        with self._cv:
+            return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def _loop(self) -> None:
+        while True:
+            admits, shed = self._wait_for_work()
+            if admits is None:
+                return
+            for req in shed:
+                self.metrics.observe_shed()
+                with self._cv:
+                    retry_after = self._retry_after_locked()
+                req.handle._fail(DeadlineExceededError(
+                    f"request exceeded its {self.deadline_ms:.1f} ms "
+                    "deadline while queued", retry_after=retry_after))
+            for slot, req in admits:
+                try:
+                    self._prefill_into(slot, req)
+                except Exception as exc:   # noqa: BLE001 — fail the caller
+                    req.handle._fail(exc)
+                    with self._cv:
+                        # idempotent recovery: close() may have already
+                        # nulled the slot AND refilled _free underneath
+                        # the in-flight prefill — only free what is
+                        # still ours
+                        if self._slots[slot] is not None:
+                            self._slots[slot] = None
+                            self._free.append(slot)
+            if self.active_slots:
+                try:
+                    self._step()
+                except Exception as exc:   # noqa: BLE001 — worker survives
+                    logger.exception("decode step failed; failing the "
+                                     "active sequences")
+                    with self._cv:
+                        active = [(i, s) for i, s in enumerate(self._slots)
+                                  if s is not None]
+                        for i, s in active:
+                            self._slots[i] = None
+                            self._free.append(i)
+                    for _, s in active:
+                        s.req.handle._fail(exc)
+
+    def _wait_for_work(self):
+        """Block until there is something to do. Returns
+        ``(admissions, shed)`` — admissions is None when the worker
+        should exit (closed, or drained dry)."""
+        with self._cv:
+            while True:
+                if self._state == "closed":
+                    return None, []
+                n_active = sum(1 for s in self._slots if s is not None)
+                if n_active or (self._pending and self._free):
+                    break
+                if self._state == "draining" and not self._pending:
+                    return None, []
+                self._cv.wait(timeout=0.25)
+            shed: List[_Request] = []
+            admits: List[Tuple[int, _Request]] = []
+            now = time.monotonic()
+            if self.deadline_ms is not None:
+                # sweep expired requests EVERY wakeup, not only when a
+                # slot is free: while every slot is busy with long
+                # generations, expired entries must still fail fast AND
+                # stop counting against max_queue (the batch tier's
+                # batcher sheds each flush cycle the same way). The
+                # queue is FIFO over submit times, so only the front
+                # can be expired.
+                cutoff = now - self.deadline_ms / 1e3
+                while self._pending and self._pending[0].t_submit < cutoff:
+                    shed.append(self._pending.popleft())
+            while self._pending and self._free:
+                req = self._pending.popleft()
+                slot = self._free.popleft()
+                self._slots[slot] = _Active(req)
+                admits.append((slot, req))
+            return admits, shed
+
+    def _prefill_into(self, slot: int, req: _Request) -> None:
+        """Admit one sequence at a step boundary: prefill its prompt
+        through the length-bucketed cache, join the K/V planes into the
+        slot's cache range, emit the first greedy token."""
+        n = int(req.prompt.shape[0])
+        t0 = time.perf_counter()
+        with profiler.scope(f"decode::{self.name}::prefill"), \
+                telemetry.attribute(f"decode.{self.name}",
+                                    detail=f"prefill len={n}"):
+            first, k_pad, v_pad = self._prefill(req.prompt)
+            join = self._join_exec(self._prefill.bucket_for(n))
+            self._kv.k, self._kv.v = join(self._kv.k, self._kv.v, k_pad,
+                                          v_pad, jnp.asarray(slot,
+                                                             jnp.int32))
+            first_tok = int(first)                    # the D2H fence
+        dt = time.perf_counter() - t0
+        now = time.monotonic()
+        with self._cv:
+            st = self._slots[slot]
+            if st is None:                 # closed underneath the prefill
+                return
+            self._cache_len[slot] = n
+            self._tokens[slot] = first_tok
+        st.generated = 1
+        self.metrics.observe_admit(st.t_admitted - req.t_submit, dt)
+        self.metrics.observe_first_token(now - req.t_submit)
+        self.metrics.observe_prefill_token()
+        req.handle._put(first_tok)
+        # capacity cannot end a sequence here: submit() rejects prompts
+        # with n >= max_len, so there is always room for one decode step
+        done = first_tok == req.eos_id or st.generated >= req.max_new
+        if done:
+            self._finish_slot(slot)
+        self.metrics.observe_slots(self.active_slots)
+
+    def _step(self) -> None:
+        """One decode step for every occupied slot (free slots compute
+        too — their rows are ignored and their writes land in freed
+        space). The ONLY hot-path dispatch: no shape in it depends on
+        which slots are live or how old their sequences are."""
+        with self._cv:
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+            cache_len = self._cache_len.copy()
+            tokens = self._tokens.copy()
+        k = len(active)
+        t0 = time.perf_counter()
+        with self._meter.step(
+                h2d_bytes=int(cache_len.nbytes + tokens.nbytes),
+                detail=f"active={k}", flops_fn=self._decode_flops):
+            with profiler.scope(f"decode::{self.name}::step"):
+                ex = self._decode_exec()
+                nxt, self._kv.k, self._kv.v = ex(
+                    self._params, self._kv.k, self._kv.v,
+                    jnp.asarray(cache_len), jnp.asarray(tokens))
+                nxt_np = np.asarray(nxt)              # the D2H fence
+        dt = time.perf_counter() - t0
+        self.metrics.observe_step(k, dt, k)
+        finished: List[int] = []
+        with self._cv:
+            for i in active:
+                st = self._slots[i]
+                if st is None:        # closed underneath us
+                    continue
+                self._cache_len[i] += 1
+                tok = int(nxt_np[i])
+                self._tokens[i] = tok
+                st.generated += 1
+                st.req.handle._put(tok)
+                if (tok == st.req.eos_id or st.generated >= st.req.max_new
+                        or self._cache_len[i] >= self.max_len):
+                    finished.append(i)
+        for i in finished:
+            self._finish_slot(i)
+        self.metrics.observe_slots(self.active_slots)
+
+    def _finish_slot(self, slot: int) -> None:
+        """Retire a finished sequence: resolve its handle, free the slot
+        (neighbouring slots keep decoding untouched), emit the
+        per-request JSONL record."""
+        with self._cv:
+            st = self._slots[slot]
+            if st is None:
+                return
+            # occupancy INCLUDING this request: the record describes the
+            # load the request ran under, not the state it left behind
+            n_active = sum(1 for s in self._slots if s is not None)
+            self._slots[slot] = None
+            self._free.append(slot)
+            # reset the mirrors: a capacity-finished slot would otherwise
+            # keep cache_len == max_len and feed an out-of-table position
+            # index into every later step (harmless only via XLA's clamp
+            # semantics — don't rely on it)
+            self._cache_len[slot] = 0
+            self._tokens[slot] = 0
+            self._cv.notify_all()
+        st.req.handle._finish()
+        self.metrics.observe_finish()
+        now = time.monotonic()
+        telemetry.jsonl_emit({
+            "kind": "decode", "model": self.name,
+            "prompt_len": int(st.req.prompt.shape[0]),
+            "new_tokens": st.generated,
+            "queue_wait_ms": round(
+                (st.t_admitted - st.req.t_submit) * 1e3, 3),
+            "wall_ms": round((now - st.req.t_submit) * 1e3, 3),
+            "slots_active": n_active,
+        })
+
+    # -- lifecycle ------------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful: refuse new requests, finish every queued and active
+        sequence; after ``timeout`` (default
+        ``MXTPU_SERVING_DRAIN_TIMEOUT_S``) force-close. True on a clean
+        drain."""
+        if timeout is None:
+            from ..config import config
+
+            timeout = float(config.get("MXTPU_SERVING_DRAIN_TIMEOUT_S"))
+        with self._cv:
+            if self._state == "running":
+                self._state = "draining"
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return True
+        logger.warning(
+            "drain of decode session %s did not finish within %.1fs "
+            "(queue_depth=%d active=%d); force-closing", self.name,
+            timeout, self.queue_depth, self.active_slots)
+        self.close(join_timeout=0.5)
+        return False
+
+    def close(self, join_timeout: float = 5.0) -> None:
+        """Immediate: fail queued and active requests, stop the worker."""
+        with self._cv:
+            self._state = "closed"
+            pending = list(self._pending)
+            self._pending.clear()
+            active = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.max_slots
+            self._free = deque(range(self.max_slots))
+            self._cv.notify_all()
+        for req in pending:
+            req.handle._fail(ServerClosedError("decode session closed"))
+        for st in active:
+            st.req.handle._fail(ServerClosedError("decode session closed"))
+        self._worker.join(timeout=join_timeout)
+
+    def __enter__(self) -> "DecodeSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc and exc[0] is None:
+            self.drain(timeout=30.0)
+        self.close()
+
+    # -- introspection --------------------------------------------------------
+    def healthz(self) -> dict:
+        """Readiness probe with the ModelServer contract: ``ready`` only
+        while accepting traffic."""
+        with self._cv:
+            state = self._state
+            depth = len(self._pending)
+            active = sum(1 for s in self._slots if s is not None)
+        return {
+            "ready": state == "running",
+            "state": state,
+            "model": self.name,
+            "queue_depth": depth,
+            "slots": {"active": active, "total": self.max_slots},
+            "compiled": {
+                "prefill_buckets": len(self._prefill.compiled_signatures()),
+                "joins": len(self._joins),
+                "decode": self._dec_ex is not None,
+            },
+        }
+
+    @property
+    def prefill_buckets(self) -> Tuple[int, ...]:
+        return self._prefill.buckets
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["prefill_buckets"] = list(self._prefill.buckets)
+        snap["prefill_cache"] = self._prefill.metrics.snapshot()[
+            "executor_cache"]
+        snap["max_len"] = self.max_len
+        if self._meter.ema_seconds is not None:
+            snap["step_ema_ms"] = self._meter.ema_seconds * 1e3
+        return snap

@@ -37,16 +37,24 @@ from .metrics import ServingMetrics
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
-def block_apply_fn(block) -> Tuple[Callable, List[Any]]:
-    """Build a pure ``apply_fn(param_values, x) -> outputs`` over a gluon
-    ``Block`` plus the initial parameter values (jax arrays, structural-
-    name order). Parameter reads inside the traced forward resolve
-    through the ``_Trace`` mechanism, so the jitted graph is pure and the
-    cache — not the Block — owns the device-resident copies. The forward
-    runs in inference mode (``training=False``: dropout off, BatchNorm
-    uses running stats; aux-state writes are dropped, not replayed).
-    """
+def pure_method_runner(block) -> Tuple[Callable, List[Any]]:
+    """``(run, params)`` — pure functional application of any Block
+    method over injected parameter values via the ``_Trace`` mechanism
+    (same tuple order as :func:`block_apply_fn`: callable first).
+
+    ``run(method, pvals, *arrays)`` unwraps the NDArray outputs to a
+    tuple of jax arrays; every call runs in inference mode
+    (``training=False``: dropout off, BatchNorm uses running stats;
+    aux-state writes are dropped, not replayed) with the matmul
+    precision the parameter dtypes imply, and with ``next_key()`` routed
+    to ``random.inference_key_provider`` — ``needs_rng`` ops draw-and-
+    drop keys even in inference, and the default provider's trace-time
+    ``fold_in`` would hoist the RNG root key into the lowered
+    computation as a phantom const input. Shared by the whole serving
+    tier: :func:`block_apply_fn` (batch forward) and the decode tier's
+    prefill/decode appliers (``decode.py``)."""
     from .. import autograd
+    from .. import random as _random
     from ..config import matmul_precision_for
     from ..gluon.block import _Trace
     from ..gluon.parameter import _trace
@@ -56,24 +64,40 @@ def block_apply_fn(block) -> Tuple[Callable, List[Any]]:
     objs = collect_params(block)
     plist = list(objs.values())
     precision = matmul_precision_for(p.dtype for p in plist)
+    nullkeys = _random.inference_key_provider()
 
-    def apply_fn(pvals, x):
+    def run(method, pvals, *arrays):
         param_map = {id(p): NDArray(v) for p, v in zip(plist, pvals)}
         trace = _Trace(param_map)
         _trace.stack.append(trace)
         try:
-            with autograd._RecordingStateScope(False, False), \
+            with nullkeys, \
+                    autograd._RecordingStateScope(False, False), \
                     jax.default_matmul_precision(precision):
-                out = block.forward(NDArray(x))
+                out = method(*[NDArray(a) for a in arrays])
         finally:
             _trace.stack.pop()
         leaves = jax.tree_util.tree_leaves(
             out, is_leaf=lambda o: isinstance(o, NDArray))
-        data = tuple(l._data if isinstance(l, NDArray) else jnp.asarray(l)
+        return tuple(l._data if isinstance(l, NDArray) else jnp.asarray(l)
                      for l in leaves)
-        return data[0] if len(data) == 1 else data
 
     params = [p.data()._data for p in plist]
+    return run, params
+
+
+def block_apply_fn(block) -> Tuple[Callable, List[Any]]:
+    """Build a pure ``apply_fn(param_values, x) -> outputs`` over a gluon
+    ``Block`` plus the initial parameter values (jax arrays, structural-
+    name order) — the single-forward special case of
+    :func:`pure_method_runner`; the jitted graph is pure and the cache —
+    not the Block — owns the device-resident copies."""
+    run, params = pure_method_runner(block)
+
+    def apply_fn(pvals, x):
+        data = run(block.forward, pvals, x)
+        return data[0] if len(data) == 1 else data
+
     return apply_fn, params
 
 
@@ -84,13 +108,27 @@ class BucketedExecutorCache:
     its first argument and a batch-leading array as its second, and
     return arrays whose leading axis is the batch axis (single array or
     tuple — de-padding slices every output to the true batch size).
+
+    Two decode-tier extensions (ISSUE 12 — the prefill path buckets on
+    SEQUENCE LENGTH with the token axis leading instead of on batch
+    size, through this same cache):
+
+    * ``pass_count=True`` — ``apply_fn(params, x, n)`` additionally
+      receives the true un-padded leading count as a traced int32
+      scalar (so e.g. prefill can read the last VALID position's
+      logits without a per-length recompile).
+    * ``depad=False`` — outputs are returned exactly as the executable
+      produced them (bucket-padded); callers that consume whole padded
+      planes (a KV-cache block write) or non-batch-leading outputs
+      slice for themselves.
     """
 
     def __init__(self, apply_fn: Callable, params: Sequence[Any],
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  donate: Optional[bool] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 name: str = "model"):
+                 name: str = "model", pass_count: bool = False,
+                 depad: bool = True):
         self.name = name
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if not self.buckets or self.buckets[0] < 1:
@@ -104,6 +142,8 @@ class BucketedExecutorCache:
             # the runtime can actually alias the buffer
             donate = jax.default_backend() != "cpu"
         self._donate = bool(donate)
+        self._pass_count = bool(pass_count)
+        self._depad = bool(depad)
         self._execs = {}
         self._lock = threading.Lock()
         self.metrics = metrics if metrics is not None \
@@ -161,7 +201,11 @@ class BucketedExecutorCache:
                 p_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
                            for p in self._params]
                 x_spec = jax.ShapeDtypeStruct((bucket,) + key[1], dtype)
-                ex = jitted.lower(p_specs, x_spec).compile()
+                if self._pass_count:
+                    n_spec = jax.ShapeDtypeStruct((), jnp.int32)
+                    ex = jitted.lower(p_specs, x_spec, n_spec).compile()
+                else:
+                    ex = jitted.lower(p_specs, x_spec).compile()
             self.metrics.observe_compile(time.perf_counter() - t0)
             self._execs[key] = ex
             return ex
@@ -187,7 +231,13 @@ class BucketedExecutorCache:
         with profiler.scope(f"serving::{self.name}::execute"):
             # fresh device array per call: required for donation, and the
             # only per-call H2D traffic (params are already resident)
-            out = ex(self._params, jnp.asarray(arr))
+            if self._pass_count:
+                out = ex(self._params, jnp.asarray(arr),
+                         jnp.asarray(n, jnp.int32))
+            else:
+                out = ex(self._params, jnp.asarray(arr))
+        if not self._depad:
+            return out
         if isinstance(out, tuple):
             return tuple(o[:n] for o in out)
         return out[:n]

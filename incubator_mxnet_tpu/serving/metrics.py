@@ -194,3 +194,175 @@ class ServingMetrics:
                                "compiles": self.compiles,
                                "compile_seconds": self.compile_seconds},
         }
+
+
+#: decode-step occupancy bucket bounds: active slots per step
+_SLOT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+class DecodeMetrics:
+    """Per-session continuous-batching decode metrics (ISSUE 12).
+
+    The ``mxtpu_decode_*`` telemetry family: slot occupancy, token
+    throughput, the prefill-vs-decode wall-time split, KV-cache bytes,
+    and the queue-wait histogram — mirrored into the shared registry
+    exactly like :class:`ServingMetrics` so decode serving shows up in
+    the same /metrics + JSONL exporters as everything else. Local ints
+    stay authoritative for ``snapshot()`` (work with telemetry off)."""
+
+    def __init__(self, model: str = "model", window: int = 2048):
+        self.model = model
+        self._lock = threading.Lock()
+        self._queue_waits = deque(maxlen=window)    # seconds, per request
+        self._ttfts = deque(maxlen=window)          # submit -> first token
+        self._active_hist = deque(maxlen=window)    # slots active per step
+        self.requests = 0
+        self.rejected = 0
+        self.shed = 0
+        self.finished = 0
+        self.tokens = 0
+        self.prefills = 0
+        self.steps = 0
+        self.prefill_seconds = 0.0
+        self.decode_seconds = 0.0
+        self.slots_active = 0
+        self.cache_bytes = 0
+        lbl = {"model": model}
+        self._t_requests = telemetry.counter(
+            "mxtpu_decode_requests_total", "decode requests admitted to "
+            "the queue", **lbl)
+        self._t_rejected = telemetry.counter(
+            "mxtpu_decode_rejected_total",
+            "decode requests rejected by backpressure", **lbl)
+        self._t_shed = telemetry.counter(
+            "mxtpu_decode_shed_total",
+            "queued decode requests shed past their deadline", **lbl)
+        self._t_finished = telemetry.counter(
+            "mxtpu_decode_finished_total", "decode requests completed",
+            **lbl)
+        self._t_tokens = telemetry.counter(
+            "mxtpu_decode_tokens_total", "tokens generated", **lbl)
+        self._t_steps = telemetry.counter(
+            "mxtpu_decode_steps_total", "decode steps executed", **lbl)
+        self._t_prefills = telemetry.counter(
+            "mxtpu_decode_prefills_total", "prefills executed", **lbl)
+        self._t_prefill_s = telemetry.counter(
+            "mxtpu_decode_prefill_seconds_total",
+            "wall time in prefill+join dispatches (the prefill half of "
+            "the prefill/decode split)", **lbl)
+        self._t_decode_s = telemetry.counter(
+            "mxtpu_decode_seconds_total",
+            "wall time in decode-step dispatches (the decode half of "
+            "the prefill/decode split)", **lbl)
+        self._t_slots = telemetry.gauge(
+            "mxtpu_decode_slots_active",
+            "KV-cache slots occupied by live sequences", **lbl)
+        self._t_slots_total = telemetry.gauge(
+            "mxtpu_decode_slots_total", "KV-cache slot capacity", **lbl)
+        self._t_cache_bytes = telemetry.gauge(
+            "mxtpu_decode_cache_bytes",
+            "device bytes held by the resident KV cache", **lbl)
+        self._t_occupancy = telemetry.histogram(
+            "mxtpu_decode_step_occupancy",
+            "active slots per decode step", buckets=_SLOT_BUCKETS, **lbl)
+        self._t_queue_wait = telemetry.histogram(
+            "mxtpu_decode_queue_wait_seconds",
+            "submit-to-slot-admission wait", **lbl)
+        self._t_step_s = telemetry.histogram(
+            "mxtpu_decode_step_seconds", "decode step wall time", **lbl)
+        self._t_prefill_hist = telemetry.histogram(
+            "mxtpu_decode_prefill_latency_seconds",
+            "per-prompt prefill+join wall time", **lbl)
+
+    def set_capacity(self, slots: int, cache_bytes: int) -> None:
+        with self._lock:
+            self.cache_bytes = int(cache_bytes)
+        self._t_slots_total.set(slots)
+        self._t_cache_bytes.set(cache_bytes)
+
+    def observe_submit(self) -> None:
+        with self._lock:
+            self.requests += 1
+        self._t_requests.inc()
+
+    def observe_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+        self._t_rejected.inc()
+
+    def observe_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+        self._t_shed.inc()
+
+    def observe_admit(self, queue_wait_s: float, prefill_s: float) -> None:
+        with self._lock:
+            self.prefills += 1
+            self.prefill_seconds += prefill_s
+            self._queue_waits.append(queue_wait_s)
+        self._t_prefills.inc()
+        self._t_prefill_s.inc(prefill_s)
+        self._t_queue_wait.observe(queue_wait_s)
+        self._t_prefill_hist.observe(prefill_s)
+
+    def observe_first_token(self, ttft_s: float) -> None:
+        with self._lock:
+            self._ttfts.append(ttft_s)
+
+    def observe_step(self, active: int, seconds: float,
+                     new_tokens: int) -> None:
+        with self._lock:
+            self.steps += 1
+            self.decode_seconds += seconds
+            self.tokens += new_tokens
+            self._active_hist.append(active)
+        self._t_steps.inc()
+        self._t_decode_s.inc(seconds)
+        self._t_tokens.inc(new_tokens)
+        self._t_occupancy.observe(active)
+        self._t_step_s.observe(seconds)
+
+    def observe_prefill_token(self, n: int = 1) -> None:
+        """Prefill emits the first generated token of a sequence."""
+        with self._lock:
+            self.tokens += n
+        self._t_tokens.inc(n)
+
+    def observe_slots(self, active: int) -> None:
+        with self._lock:
+            self.slots_active = active
+        self._t_slots.set(active)
+
+    def observe_finish(self) -> None:
+        with self._lock:
+            self.finished += 1
+        self._t_finished.inc()
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            waits = sorted(self._queue_waits)
+            ttfts = sorted(self._ttfts)
+            act = list(self._active_hist)
+            total = self.prefill_seconds + self.decode_seconds
+            return {
+                "model": self.model,
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "shed": self.shed,
+                "finished": self.finished,
+                "tokens": self.tokens,
+                "steps": self.steps,
+                "prefills": self.prefills,
+                "slots_active": self.slots_active,
+                "cache_bytes": self.cache_bytes,
+                "mean_step_occupancy":
+                    (sum(act) / len(act)) if act else 0.0,
+                "queue_wait_ms": {f"p{p}": _percentile(waits, p) * 1e3
+                                  for p in (50, 90, 99)},
+                "ttft_ms": {f"p{p}": _percentile(ttfts, p) * 1e3
+                            for p in (50, 90, 99)},
+                "prefill_seconds": self.prefill_seconds,
+                "decode_seconds": self.decode_seconds,
+                "prefill_frac":
+                    (self.prefill_seconds / total) if total else 0.0,
+            }

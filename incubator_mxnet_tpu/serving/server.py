@@ -40,7 +40,59 @@ from .executor_cache import DEFAULT_BUCKETS, BucketedExecutorCache
 from .metrics import ServingMetrics
 
 __all__ = ["DeadlineExceededError", "ModelServer", "QueueFullError",
-           "ServerClosedError"]
+           "ServerClosedError", "load_block_checkpoint"]
+
+
+def _sharded_prefix(params_path: str) -> Optional[str]:
+    """The sharded-checkpoint prefix when ``params_path`` names one (the
+    ``{prefix}.manifest.json`` itself or the bare prefix), else None."""
+    suffix = ".manifest.json"
+    if params_path.endswith(suffix) and os.path.exists(params_path):
+        return params_path[:-len(suffix)]
+    if os.path.exists(params_path + suffix):
+        return params_path
+    return None
+
+
+def load_block_checkpoint(block, params_path: str, ctx=None,
+                          use_native: Optional[bool] = None):
+    """Load ``params_path`` into ``block`` — the loader shared by every
+    serving front door (``ModelServer.from_checkpoint`` and
+    ``DecodeSession.from_checkpoint``).
+
+    ``params_path`` may be a native ``.params`` checkpoint (read through
+    the C ABI ``mxio_params_*`` when the library is available — the same
+    reader non-Python consumers use — else ``nd.load``;
+    ``use_native=True`` makes a missing native library an error instead
+    of a silent fallback) **or a sharded training checkpoint
+    prefix/manifest** written by ``parallel.save_sharded`` on any mesh:
+    the ``param/`` + ``frozen/`` tensors are assembled at M=1 through the
+    slice-planning reshard reader (``parallel/reshard.py``) — a
+    multi-chip training checkpoint feeds the 1-chip serving tier
+    directly, no export step, optimizer state never touched
+    (docs/SERVING.md "Serving a training checkpoint")."""
+    from .. import native
+    from ..ndarray import ndarray as _ndimpl
+
+    sharded_prefix = _sharded_prefix(params_path)
+    if sharded_prefix is not None:
+        from ..parallel.reshard import load_dense_arrays
+
+        arrays = load_dense_arrays(sharded_prefix)
+        loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
+                  for k, v in arrays.items()}
+        block._load_parameters_dict(loaded, params_path, ctx=ctx)
+        return block
+    if use_native is None:
+        use_native = native.lib() is not None
+    if use_native:
+        arrays = native.native_params_load(params_path)
+        loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
+                  for k, v in arrays.items()}
+        block._load_parameters_dict(loaded, params_path, ctx=ctx)
+    else:
+        block.load_parameters(params_path, ctx=ctx)
+    return block
 
 
 class ModelServer:
@@ -110,41 +162,17 @@ class ModelServer:
         (``parallel/reshard.py``) — a multi-chip training checkpoint
         feeds the 1-chip serving tier directly, no export step,
         optimizer state never touched (docs/SERVING.md
-        "Serving a training checkpoint")."""
-        from .. import native
-        from ..ndarray import ndarray as _ndimpl
-
-        sharded_prefix = cls._sharded_prefix(params_path)
-        if sharded_prefix is not None:
-            from ..parallel.reshard import load_dense_arrays
-
-            arrays = load_dense_arrays(sharded_prefix)
-            loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
-                      for k, v in arrays.items()}
-            block._load_parameters_dict(loaded, params_path, ctx=ctx)
-            return cls(block, **kwargs)
-        if use_native is None:
-            use_native = native.lib() is not None
-        if use_native:
-            arrays = native.native_params_load(params_path)
-            loaded = {k: _ndimpl.array(v, ctx=ctx, dtype=v.dtype.name)
-                      for k, v in arrays.items()}
-            block._load_parameters_dict(loaded, params_path, ctx=ctx)
-        else:
-            block.load_parameters(params_path, ctx=ctx)
+        "Serving a training checkpoint"). The loaders are shared with
+        :class:`~.decode.DecodeSession` via
+        :func:`load_block_checkpoint`."""
+        load_block_checkpoint(block, params_path, ctx=ctx,
+                              use_native=use_native)
         return cls(block, **kwargs)
 
     @staticmethod
     def _sharded_prefix(params_path: str) -> Optional[str]:
-        """The sharded-checkpoint prefix when ``params_path`` names one
-        (the ``{prefix}.manifest.json`` itself or the bare prefix),
-        else None."""
-        suffix = ".manifest.json"
-        if params_path.endswith(suffix) and os.path.exists(params_path):
-            return params_path[:-len(suffix)]
-        if os.path.exists(params_path + suffix):
-            return params_path
-        return None
+        """Back-compat alias of the module-level :func:`_sharded_prefix`."""
+        return _sharded_prefix(params_path)
 
     @classmethod
     def from_exported(cls, path: str, ctx=None, **kwargs) -> "ModelServer":

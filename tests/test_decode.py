@@ -1,0 +1,421 @@
+"""Decode serving tests (ISSUE 12): KV-cache continuous batching.
+
+The contracts pinned here: greedy decode through the slot cache is
+bit-exact against the full-sequence forward oracle across join/leave
+churn; steady-state decode over mixed-age sequences performs ZERO
+post-warmup compiles under the armed recompile watchdog; the front door
+preserves the serving-tier semantics (backpressure, deadline shedding,
+drain/healthz); and one decoder config covers
+train (SuperStep + ZeRO-2) -> sharded checkpoint -> ``from_checkpoint``
+-> decode end-to-end."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, serving, telemetry
+from incubator_mxnet_tpu.config import config
+from incubator_mxnet_tpu.gluon.model_zoo import get_gpt
+
+VOCAB = 61
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    for k in ("MXTPU_DECODE_SLOTS", "MXTPU_DECODE_MAX_LEN",
+              "MXTPU_DECODE_BUCKETS", "MXTPU_DECODE_MAX_NEW_TOKENS"):
+        config.unset(k)
+
+
+def _tiny_net(seed=0, max_length=48, dropout=0.1, units=32, layers=2):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = get_gpt("gpt_decoder_tiny", vocab_size=VOCAB, units=units,
+                  num_layers=layers, max_length=max_length,
+                  dropout=dropout)
+    net.initialize(init="xavier")
+    return net
+
+
+def _oracle(net, prompt, n_new, eos=None):
+    """Greedy reference: re-run the full causal forward per token."""
+    seq = list(int(t) for t in prompt)
+    out = []
+    for _ in range(n_new):
+        lg = net(mx.nd.array(np.array(seq)[None], dtype="int32")).asnumpy()
+        tok = int(np.argmax(lg[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+        if eos is not None and tok == eos:
+            break
+    return out
+
+
+def _prompts(ns, seed=7):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, VOCAB, (int(n),)).astype(np.int32) for n in ns]
+
+
+# ---------------------------------------------------------------------------
+# the core contract: bit-exact greedy streams across churn
+# ---------------------------------------------------------------------------
+def test_greedy_bit_exact_across_join_leave_churn():
+    net = _tiny_net()
+    sess = serving.DecodeSession(net, max_slots=4, max_len=48,
+                                 prefill_buckets=(8, 16), name="churn")
+    try:
+        sess.warmup()
+        prompts = _prompts([5, 11, 3, 16, 7, 9, 13, 4])
+        news = [6, 9, 4, 7, 12, 5, 8, 10]
+        handles = [sess.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, news)]
+        got = [h.result(120) for h in handles]
+        for i, (p, n, g) in enumerate(zip(prompts, news, got)):
+            assert g == _oracle(net, p, n), f"request {i} diverged"
+        s = sess.stats()
+        # 8 ragged sequences over 4 slots: continuous batching must have
+        # overlapped them (occupancy > 1) and every request finished
+        assert s["finished"] == len(prompts)
+        assert s["mean_step_occupancy"] > 1.0
+        assert s["tokens"] == sum(len(g) for g in got)
+        assert 0.0 < s["prefill_frac"] < 1.0
+        assert sess.drain(60)
+    finally:
+        sess.close()
+
+
+def test_streaming_tokens_arrive_per_step():
+    net = _tiny_net()
+    with serving.DecodeSession(net, max_slots=2, max_len=48,
+                               prefill_buckets=(8,), name="stream") as sess:
+        sess.warmup()
+        h = sess.submit(_prompts([6])[0], max_new_tokens=5)
+        streamed = list(h)                     # iterator ends at finish
+        assert streamed == h.result(10)
+        assert len(streamed) == 5
+
+
+def test_eos_stops_generation_inclusive():
+    net = _tiny_net(seed=3)
+    prompt = _prompts([9], seed=3)[0]
+    free_run = _oracle(net, prompt, 8)
+    eos = free_run[3]                          # force a mid-stream stop
+    want = _oracle(net, prompt, 8, eos=eos)
+    assert want[-1] == eos and len(want) <= 8
+    with serving.DecodeSession(net, max_slots=2, max_len=48,
+                               prefill_buckets=(16,), name="eos") as sess:
+        got = sess.generate(prompt, max_new_tokens=8, eos_id=eos)
+    assert got == want
+
+
+def test_cache_capacity_finishes_and_frees_slot():
+    net = _tiny_net()
+    max_len = 24
+    prompt = _prompts([20])[0]
+    with serving.DecodeSession(net, max_slots=1, max_len=max_len,
+                               prefill_buckets=(20,), name="cap") as sess:
+        sess.warmup()
+        got = sess.generate(prompt, max_new_tokens=100)
+        # prefill fills 20; steps write at 20..23 -> 4 more writes, and
+        # the step that fills the last position still emits its token
+        assert len(got) == max_len - len(prompt) + 1
+        assert got == _oracle(net, prompt, len(got))
+        # the slot came back: a second request is served, not starved
+        got2 = sess.generate(_prompts([4])[0], max_new_tokens=3)
+        assert len(got2) == 3
+
+
+# ---------------------------------------------------------------------------
+# front-door semantics: backpressure, shedding, drain/healthz
+# ---------------------------------------------------------------------------
+def test_backpressure_queue_full():
+    net = _tiny_net()
+    sess = serving.DecodeSession(net, max_slots=1, max_len=48,
+                                 prefill_buckets=(8,), max_queue=4,
+                                 name="bp")
+    try:
+        sess.warmup()
+        handles = [sess.submit(p, max_new_tokens=20)
+                   for p in _prompts([5, 5])]
+        with pytest.raises(serving.QueueFullError) as ei:
+            for _ in range(30):                # queue capacity is 4
+                handles.append(sess.submit(_prompts([5])[0],
+                                           max_new_tokens=20))
+        assert ei.value.retry_after > 0
+        assert sess.stats()["rejected"] >= 1
+        for h in handles:
+            h.result(120)
+    finally:
+        sess.close()
+
+
+def test_deadline_shed_while_queued():
+    net = _tiny_net(max_length=448)
+    sess = serving.DecodeSession(net, max_slots=1, max_len=448,
+                                 prefill_buckets=(8,), deadline_ms=30.0,
+                                 name="shed")
+    try:
+        sess.warmup()
+        first = sess.submit(_prompts([6])[0], max_new_tokens=400)
+        # wait for the first STREAMED token: the slot is now provably
+        # occupied, so the late requests below must queue for ~399 more
+        # decode steps — far past the 30 ms deadline — while `first`
+        # itself was admitted deadline-free (determinism: the deadline
+        # is generous vs worker wakeup, small vs the running sequence)
+        it = iter(first)
+        next(it)
+        late = [sess.submit(p, max_new_tokens=2)
+                for p in _prompts([4, 4], seed=9)]
+        for h in late:
+            with pytest.raises(serving.DeadlineExceededError) as ei:
+                h.result(120)
+            assert ei.value.retry_after > 0
+        # the sweep runs at every step boundary, not only when a slot
+        # frees: expired requests fail fast (and stop holding queue
+        # room) while the single slot is still mid-generation
+        assert not first.done(), "shed should not wait for a free slot"
+        assert len(first.result(300)) == 400
+        assert sess.stats()["shed"] == len(late)
+    finally:
+        sess.close()
+
+
+def test_submit_validation_and_lifecycle():
+    net = _tiny_net()
+    sess = serving.DecodeSession(net, max_slots=1, max_len=16,
+                                 prefill_buckets=(8,), name="val")
+    with pytest.raises(ValueError, match="empty"):
+        sess.submit([])
+    with pytest.raises(ValueError, match="bucket"):
+        sess.submit(np.arange(9))              # > largest bucket
+    with pytest.raises(ValueError, match="cache room"):
+        sess2 = serving.DecodeSession(net, max_slots=1, max_len=8,
+                                      prefill_buckets=(8,), name="val2")
+        try:
+            sess2.submit(np.arange(8))         # prompt == max_len
+        finally:
+            sess2.close()
+    h = sess.healthz()
+    assert h["ready"] and h["state"] == "running"
+    assert h["slots"] == {"active": 0, "total": 1}
+    assert sess.drain(30)
+    with pytest.raises(serving.ServerClosedError):
+        sess.submit([1, 2])
+    assert not sess.healthz()["ready"]
+    sess.close()
+
+
+def test_defaults_come_from_config_knobs():
+    config.set("MXTPU_DECODE_SLOTS", 3)
+    config.set("MXTPU_DECODE_MAX_LEN", 32)
+    config.set("MXTPU_DECODE_BUCKETS", "8,16,64")   # 64 > max_len: drops
+    config.set("MXTPU_DECODE_MAX_NEW_TOKENS", 4)
+    net = _tiny_net()
+    with serving.DecodeSession(net, name="knobs") as sess:
+        assert sess.max_slots == 3
+        assert sess.max_len == 32
+        assert sess.prefill_buckets == (8, 16)
+        got = sess.generate(_prompts([5])[0])   # default budget: 4
+    assert len(got) == 4
+
+
+# ---------------------------------------------------------------------------
+# the recompile contract (satellite): zero post-warmup compiles
+# ---------------------------------------------------------------------------
+def test_steady_state_decode_zero_recompiles_under_watchdog():
+    """Mixed-age churn against the armed PR 4 watchdog: after warmup,
+    the fixed executable set must serve ANY mix of prompt lengths,
+    sequence ages and slot occupancies without one more XLA compile."""
+    net = _tiny_net()
+    wd = telemetry.get_watchdog()
+    assert wd is not None
+    sess = serving.DecodeSession(net, max_slots=3, max_len=48,
+                                 prefill_buckets=(8, 16), name="steady")
+    try:
+        sess.warmup()
+        # first churn wave drives every executable past the warmup
+        # budget (default 10 steps)
+        for h in [sess.submit(p, max_new_tokens=n) for p, n in
+                  zip(_prompts([5, 12, 3, 9], seed=1), (8, 6, 12, 7))]:
+            h.result(120)
+        assert telemetry.get_watchdog().steps(
+            f"decode.{sess.name}") > int(
+                config.get("MXTPU_RECOMPILE_WARMUP_STEPS"))
+        compiles_before = wd.compile_count
+        # steady state: new lengths-mixes, joins and leaves — same
+        # executables
+        for h in [sess.submit(p, max_new_tokens=n) for p, n in
+                  zip(_prompts([4, 15, 7, 2, 11], seed=2),
+                      (9, 5, 11, 6, 8))]:
+            h.result(120)
+        assert wd.compile_count == compiles_before, \
+            "steady-state decode compiled something"
+        assert not wd.flagged(), [e.__dict__ for e in wd.flagged()]
+    finally:
+        sess.close()
+
+
+def test_prefill_bucket_policy_compiles_once_per_bucket():
+    net = _tiny_net()
+    with serving.DecodeSession(net, max_slots=2, max_len=48,
+                               prefill_buckets=(8, 16),
+                               name="buckets") as sess:
+        sess.warmup()
+        pre = sess.stats()["prefill_cache"]
+        assert pre["compiles"] == 2            # one per length bucket
+        for n in (3, 8, 5):                    # all land in bucket 8
+            sess.generate(_prompts([n])[0], max_new_tokens=2)
+        sess.generate(_prompts([12])[0], max_new_tokens=2)  # bucket 16
+        post = sess.stats()["prefill_cache"]
+        assert post["compiles"] == 2           # warmup covered them all
+        assert post["hits"] == 4
+
+
+# ---------------------------------------------------------------------------
+# executor-cache extensions the prefill path rides on
+# ---------------------------------------------------------------------------
+def test_executor_cache_pass_count_and_depad():
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.serving import BucketedExecutorCache
+
+    def apply_fn(params, x, n):
+        # returns the padded input (depad=False must hand it back whole)
+        # and a scalar derived from the TRACED true count
+        mask = jnp.arange(x.shape[0]) < n
+        return x + params[0], jnp.sum(jnp.where(mask, x, 0.0)
+                                      ).astype(jnp.float32)
+
+    cache = BucketedExecutorCache(apply_fn, [np.float32(1.0)],
+                                  buckets=(4, 8), pass_count=True,
+                                  depad=False, name="ext")
+    x = np.arange(3, dtype=np.float32)
+    padded, s = cache(x)
+    assert padded.shape == (4,)                # bucket-shaped, no de-pad
+    np.testing.assert_allclose(np.asarray(padded), [1, 2, 3, 1])
+    assert float(s) == 3.0                     # 0+1+2: only true rows
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the mxtpu_decode_* family, JSONL records, report section
+# ---------------------------------------------------------------------------
+def test_decode_metrics_family_and_report(tmp_path):
+    path = str(tmp_path / "decode.jsonl")
+    telemetry.set_jsonl(path)
+    net = _tiny_net()
+    with serving.DecodeSession(net, max_slots=2, max_len=48,
+                               prefill_buckets=(8,), name="tele") as sess:
+        sess.warmup()
+        for h in [sess.submit(p, max_new_tokens=4)
+                  for p in _prompts([5, 6, 4], seed=4)]:
+            h.result(120)
+        snap = sess.stats()
+    telemetry.set_jsonl(None)
+    assert snap["tokens"] >= 12 and snap["cache_bytes"] > 0
+    text = telemetry.prometheus_text()
+    for fam in ("mxtpu_decode_tokens_total", "mxtpu_decode_slots_active",
+                "mxtpu_decode_prefill_seconds_total",
+                "mxtpu_decode_seconds_total", "mxtpu_decode_cache_bytes",
+                "mxtpu_decode_queue_wait_seconds"):
+        assert fam in text, f"{fam} missing from /metrics"
+    # one kind:"decode" JSONL record per finished request; the report
+    # tool renders them and exposes the --compare keys
+    records = telemetry.read_jsonl(path)
+    decs = [r for r in records if r.get("kind") == "decode"]
+    assert len(decs) == 3
+    assert all(r["model"] == "tele" and r["new_tokens"] == 4
+               for r in decs)
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+
+    out = telemetry_report.summarize(path)
+    assert "decode (per request)" in out and "tele" in out
+    keys = telemetry_report._comparable_metrics(records)
+    assert keys["decode/tele/requests"] == 3.0
+    assert keys["decode/tele/tokens"] == 12.0
+
+
+def test_open_loop_serving_rows_compare_keys(tmp_path):
+    """The shared open-loop harness emits kind:'serving' rows that
+    --compare flattens per rate point."""
+    # keys come from the NOMINAL rate, not the measured offered_rps
+    # (the Poisson draw differs run to run; see telemetry_report)
+    rows = [{"kind": "serving", "mode": "open_loop", "model": "m",
+             "rate": 50.0, "offered_rps": 49.84, "achieved_rps": 49.5,
+             "p50_ms": 3.0, "p99_ms": 9.0, "shed": 1}]
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+
+    keys = telemetry_report._comparable_metrics(rows)
+    assert keys["serving/m/rate50/p99_ms"] == 9.0
+    assert keys["serving/m/rate50/achieved_rps"] == 49.5
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: train (SuperStep + ZeRO-2) -> checkpoint -> decode
+# ---------------------------------------------------------------------------
+def test_train_checkpoint_decode_end_to_end(tmp_path):
+    """One decoder config through the whole stack: SuperStep + ZeRO-2
+    training on the 8-device mesh, sharded checkpoint,
+    ``DecodeSession.from_checkpoint`` at M=1, greedy decode bit-exact
+    against the TRAINED weights' full-sequence oracle."""
+    import jax
+
+    from incubator_mxnet_tpu.parallel.superstep import stack_window
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    B, T = 2 * len(jax.devices()), 12
+    net = _tiny_net(seed=5, dropout=0.0)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return ce(logits, labels).mean()
+
+    trainer = parallel.SPMDTrainer(
+        net, lm_loss, "sgd", {"learning_rate": 0.05, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}), zero_stage=2)
+
+    def batch(i):
+        rs = np.random.RandomState(100 + i)
+        return (rs.randint(1, VOCAB, (B, T)).astype(np.int32),
+                rs.randint(1, VOCAB, (B, T)).astype(np.float32))
+
+    config.set("MXTPU_SUPERSTEP", "1")
+    try:
+        win = stack_window([batch(i) for i in range(4)])
+        losses = np.asarray(jax.device_get(
+            trainer.run_superstep(win[0], win[1])))
+        assert losses.shape == (4,) and np.isfinite(losses).all()
+    finally:
+        config.unset("MXTPU_SUPERSTEP")
+
+    prefix = str(tmp_path / "gpt-ckpt")
+    parallel.save_sharded(prefix, trainer)
+
+    # the trained weights, synced back for the oracle
+    trainer.sync_to_net()
+    prompt = _prompts([7], seed=6)[0]
+    want = _oracle(net, prompt, 6)
+
+    # a FRESH block restored from the sharded checkpoint at M=1
+    net2 = _tiny_net(seed=99, dropout=0.0)   # different init, overwritten
+    sess = serving.DecodeSession.from_checkpoint(
+        net2, prefix, max_slots=2, max_len=32, prefill_buckets=(8,),
+        name="e2e")
+    try:
+        got = sess.generate(prompt, max_new_tokens=6)
+    finally:
+        sess.close()
+    assert got == want, "decode from the restored checkpoint diverged " \
+                        "from the trained oracle"

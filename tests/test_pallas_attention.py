@@ -408,3 +408,130 @@ def test_ulysses_pallas_matches_xla():
             q, k, v, mesh, causal=causal, impl="pallas"))
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5,
                                    err_msg=f"causal={causal}")
+
+
+# ---------------------------------------------------------------------------
+# causal-mask-with-cache-offset path (ISSUE 12: KV-cache decode alignment)
+# ---------------------------------------------------------------------------
+def _brute_cache_offset(q, k, v, lens, scale):
+    """Numpy oracle: query row i of sample b sits at absolute position
+    lens[b] - tq + i and attends keys [0, lens[b] - tq + i] EXACTLY."""
+    B, H, tq, D = q.shape
+    out = np.zeros_like(q, dtype=np.float64)
+    for b in range(B):
+        for h in range(H):
+            for i in range(tq):
+                pos = lens[b] - tq + i
+                s = (q[b, h, i].astype(np.float64)
+                     @ k[b, h, :pos + 1].astype(np.float64).T) * scale
+                w = np.exp(s - s.max())
+                w /= w.sum()
+                out[b, h, i] = w @ v[b, h, :pos + 1].astype(np.float64)
+    return out.astype(np.float32)
+
+
+@pytest.mark.parametrize("tq", [1, 4])
+def test_cache_offset_attends_prefix_exactly(tq):
+    """Decode step t attends [0, t] exactly — both the Pallas kernel
+    (interpreter) and the XLA dense path against the numpy oracle, over
+    a PADDED key buffer with mixed per-slot fill levels."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (_xla_reference,
+                                                          flash_attention)
+
+    rs = np.random.RandomState(0)
+    B, H, D, Tbuf = 3, 2, 8, 32
+    lens = np.array([20, tq, 32], np.int32)      # incl. a fresh sequence
+    q = rs.randn(B, H, tq, D).astype(np.float32)
+    k = rs.randn(B, H, Tbuf, D).astype(np.float32)
+    v = rs.randn(B, H, Tbuf, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+    ref = _brute_cache_offset(q, k, v, lens, scale)
+    got_p = flash_attention(q, k, v, lengths=jnp.asarray(lens),
+                            cache_offset=True, interpret=True)
+    got_x = _xla_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           jnp.asarray(lens), scale, True,
+                           cache_offset=True)
+    np.testing.assert_allclose(np.asarray(got_p), ref, rtol=2e-5,
+                               atol=2e-6, err_msg="pallas")
+    np.testing.assert_allclose(np.asarray(got_x), ref, rtol=2e-5,
+                               atol=2e-6, err_msg="xla")
+
+
+def test_cache_offset_matches_full_sequence_forward():
+    """The decode contract: attention of the single token at position t
+    over a padded cache with lengths=t+1 equals row t of the causal
+    full-sequence forward (the oracle the decode tier is bit-exact-greedy
+    against), for every t, on both implementations."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (_xla_reference,
+                                                          flash_attention)
+
+    rs = np.random.RandomState(1)
+    B, H, D, T, Tbuf = 2, 2, 8, 12, 16
+    q = rs.randn(B, H, T, D).astype(np.float32)
+    k = rs.randn(B, H, T, D).astype(np.float32)
+    v = rs.randn(B, H, T, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+    full = np.asarray(_xla_reference(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), None, scale, True))
+    kp = np.zeros((B, H, Tbuf, D), np.float32)
+    vp = np.zeros((B, H, Tbuf, D), np.float32)
+    kp[:, :, :T], vp[:, :, :T] = k, v
+    for t in range(T):
+        lens = jnp.full((B,), t + 1, jnp.int32)
+        for name, dec in (
+                ("xla", _xla_reference(
+                    jnp.asarray(q[:, :, t:t + 1]), jnp.asarray(kp),
+                    jnp.asarray(vp), lens, scale, True,
+                    cache_offset=True)),
+                ("pallas", flash_attention(
+                    q[:, :, t:t + 1], kp, vp, lengths=lens,
+                    cache_offset=True, interpret=True))):
+            np.testing.assert_allclose(
+                np.asarray(dec)[:, :, 0], full[:, :, t], rtol=1e-5,
+                atol=5e-6, err_msg=f"{name} t={t}")
+
+
+def test_cache_offset_grads_match_xla():
+    """The cache-offset backward kernels (dq over KV blocks, dk/dv over
+    Q blocks with the per-sample diagonal) agree with autodiff through
+    the XLA reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.pallas_attention import (_xla_reference,
+                                                          flash_attention)
+
+    rs = np.random.RandomState(2)
+    B, H, tq, D, Tbuf = 2, 2, 4, 8, 24
+    lens = jnp.asarray(np.array([17, 9], np.int32))
+    q = jnp.asarray(rs.randn(B, H, tq, D).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, H, Tbuf, D).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, H, Tbuf, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    def loss_p(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, lengths=lens,
+                                       cache_offset=True,
+                                       interpret=True) ** 2)
+
+    def loss_x(q, k, v):
+        return jnp.sum(_xla_reference(q, k, v, lens, scale, True,
+                                      cache_offset=True) ** 2)
+
+    gp = jax.grad(loss_p, (0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_x, (0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gx, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   **_grad_tols(), err_msg=f"d{name}")
+
+
+def test_cache_offset_requires_lengths():
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 1, 4, 8).astype(np.float32)
+    with pytest.raises(ValueError, match="lengths"):
+        nd.invoke_op("flash_attention", nd.array(x), nd.array(x),
+                     nd.array(x), cache_offset=True)

@@ -184,6 +184,31 @@ def summarize(path: str, merge: bool = False) -> str:
                 f"{site:24s} {n_batches:8d} "
                 f"{(f'{bounds[-1]:.1f}' if bounds else '-'):>13s} "
                 f"{sum(1 for r in recs if r.get('epoch_end')):7d}")
+    decs: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "decode":
+            decs.setdefault(r.get("model", "?"), []).append(r)
+    if decs:
+        # continuous-batching decode (ISSUE 12): one record per finished
+        # request; the per-step wall/MFU numbers ride the decode.<model>
+        # step site above
+        lines.append("")
+        lines.append(f"{'decode (per request)':24s} {'requests':>9s} "
+                     f"{'tokens':>8s} {'tok/req':>8s} {'occupancy':>10s} "
+                     f"{'wait p95 ms':>12s} {'wall p95 ms':>12s}")
+        for model in sorted(decs):
+            recs = decs[model]
+            toks = sum(int(r.get("new_tokens", 0)) for r in recs)
+            waits = [r["queue_wait_ms"] for r in recs
+                     if "queue_wait_ms" in r]
+            walls = [r["wall_ms"] for r in recs if "wall_ms" in r]
+            occ = [r["slots_active"] for r in recs
+                   if "slots_active" in r]
+            lines.append(
+                f"{model:24s} {len(recs):9d} {toks:8d} "
+                f"{toks / max(1, len(recs)):8.1f} "
+                f"{(sum(occ) / len(occ)) if occ else 0.0:10.2f} "
+                f"{_pctl(waits, 95):12.2f} {_pctl(walls, 95):12.2f}")
     res = [r for r in records if r.get("kind") == "resilience"]
     if res:
         counts: Dict[str, int] = {}
@@ -284,6 +309,35 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
         mfus = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
         if mfus:
             out[f"step/{site}/mfu_pct"] = mfus[-1]
+    # serving open-loop rows (serving_bench --open-loop / decode_bench):
+    # the p99-vs-offered-load curve, diffable per rate point. Keys use
+    # the NOMINAL requested rate ("rate"), not the measured Poisson
+    # offered_rps — the measured value differs between runs, so keys
+    # built from it would never match across rounds
+    for r in records:
+        if r.get("kind") == "serving" and r.get("mode") == "open_loop":
+            rate = r.get("rate", r.get("offered_rps", "?"))
+            if isinstance(rate, float) and rate.is_integer():
+                rate = int(rate)
+            base = f"serving/{r.get('model', '?')}/rate{rate}"
+            for key in ("achieved_rps", "p50_ms", "p99_ms", "shed"):
+                if isinstance(r.get(key), (int, float)):
+                    out[f"{base}/{key}"] = float(r[key])
+    # per-request decode records aggregate into per-model compare keys
+    dec_by_model: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "decode":
+            dec_by_model.setdefault(r.get("model", "?"), []).append(r)
+    for model, recs in dec_by_model.items():
+        toks = sum(int(r.get("new_tokens", 0)) for r in recs)
+        out[f"decode/{model}/requests"] = float(len(recs))
+        out[f"decode/{model}/tokens"] = float(toks)
+        waits = [r["queue_wait_ms"] for r in recs if "queue_wait_ms" in r]
+        if waits:
+            out[f"decode/{model}/queue_wait_p95_ms"] = _pctl(waits, 95)
+        occ = [r["slots_active"] for r in recs if "slots_active" in r]
+        if occ:
+            out[f"decode/{model}/occupancy"] = sum(occ) / len(occ)
     n_rec: Dict[str, int] = {}
     for r in records:
         if r.get("kind") == "recompile":
