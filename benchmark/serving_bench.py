@@ -169,16 +169,147 @@ def run_open_loop(net, xs, rates, duration, wait_ms, buckets,
     return rows
 
 
-def build_net(in_dim: int, hidden: int, out_dim: int):
+def build_net(in_dim: int, hidden: int, out_dim: int, seed: int = 0):
+    import numpy as _np
+
     import incubator_mxnet_tpu as mx
 
+    _np.random.seed(seed)
     net = mx.gluon.nn.HybridSequential()
     with net.name_scope():
         net.add(mx.gluon.nn.Dense(hidden, activation="relu",
                                   in_units=in_dim))
         net.add(mx.gluon.nn.Dense(out_dim, in_units=hidden))
-    net.initialize()
+    net.initialize(mx.initializer.Xavier())
     return net
+
+
+def run_cold_start(net, feature_shape, buckets, artifact_dir):
+    """The ISSUE 14 cold-start row: warm a replica three ways — serial
+    compile (the pre-artifact baseline), thread-pool compile (first
+    boot of THIS PR), and artifact deserialization (every boot after) —
+    and report the artifact speedup vs compile-from-scratch. The
+    artifact-warmed cache must perform ZERO XLA compiles."""
+    import shutil
+    import tempfile
+
+    from incubator_mxnet_tpu.serving import BucketedExecutorCache
+
+    own_tmp = artifact_dir is None
+    if own_tmp:
+        artifact_dir = tempfile.mkdtemp(prefix="mxtpu-artifacts-")
+    try:
+        def fresh(store):
+            return BucketedExecutorCache.from_block(
+                net, buckets=buckets, artifact_dir=store, name="bench")
+
+        c_serial = fresh("")                       # store disabled
+        t0 = time.perf_counter()
+        c_serial.warmup(feature_shape, "float32", threads=1)
+        t_serial = time.perf_counter() - t0
+
+        c_par = fresh(artifact_dir)                # compiles AND persists
+        t0 = time.perf_counter()
+        c_par.warmup(feature_shape, "float32")     # knob/auto threads
+        t_par = time.perf_counter() - t0
+
+        c_art = fresh(artifact_dir)                # deserializes
+        t0 = time.perf_counter()
+        c_art.warmup(feature_shape, "float32")
+        t_art = time.perf_counter() - t0
+
+        assert c_art.metrics.compiles == 0, (
+            "artifact-warmed cache compiled "
+            f"{c_art.metrics.compiles} executables")
+        assert c_art.metrics.artifact_hits == len(buckets)
+        row = {"kind": "serving", "mode": "cold_start", "model": "bench",
+               "buckets": len(buckets),
+               "compile_serial_s": round(t_serial, 4),
+               "compile_parallel_s": round(t_par, 4),
+               "artifact_s": round(t_art, 4),
+               "speedup_vs_compile": round(t_par / max(t_art, 1e-9), 2),
+               "speedup_vs_serial": round(t_serial / max(t_art, 1e-9), 2),
+               "artifact_compiles": c_art.metrics.compiles,
+               "artifact_hits": c_art.metrics.artifact_hits}
+        emit_row(row)
+        for metric, value, unit in (
+                ("serving_cold_start_compile_s", t_par, "s"),
+                ("serving_cold_start_serial_s", t_serial, "s"),
+                ("serving_cold_start_artifact_s", t_art, "s"),
+                ("serving_cold_start_speedup",
+                 t_par / max(t_art, 1e-9), "x")):
+            emit_row({"kind": "bench", "metric": metric,
+                      "value": round(float(value), 4), "unit": unit})
+        return row
+    finally:
+        if own_tmp:
+            shutil.rmtree(artifact_dir, ignore_errors=True)
+
+
+def run_hot_swap(net, xs, rate, duration, wait_ms, buckets, hidden,
+                 out_dim):
+    """The ISSUE 14 hot-swap row: identical open-loop Poisson load on
+    two servers — one steady, one with a live ``publish_weights`` flip
+    mid-run — comparing p99 across the flip against steady state. The
+    flip must drop nothing and compile nothing."""
+    import numpy as _np
+
+    from incubator_mxnet_tpu import serving, telemetry
+    from incubator_mxnet_tpu.parallel.spmd import collect_params
+
+    net_b = build_net(xs.shape[1], hidden, out_dim, seed=1)
+    new_weights = {k: p.data().asnumpy()
+                   for k, p in collect_params(net_b).items()}
+
+    results = {}
+    for phase in ("steady", "swap"):
+        srv = serving.ModelServer(net, buckets=buckets,
+                                  max_wait_ms=wait_ms,
+                                  max_queue=4 * buckets[-1],
+                                  name=f"hotswap-{phase}")
+        swap_stats = {}
+        try:
+            srv.warmup(xs.shape[1:], xs.dtype)
+            wd = telemetry.get_watchdog()
+            c0 = wd.compile_count if wd else 0
+
+            def fire(i, srv=srv):
+                return srv.submit(xs[i % len(xs)])
+
+            if phase == "swap":
+                def flip():
+                    time.sleep(duration / 2.0)
+                    swap_stats.update(
+                        srv.publish_weights(new_weights, version=2))
+
+                t = threading.Thread(target=flip, daemon=True)
+                t.start()
+            res = open_loop(fire, rate, duration)
+            if phase == "swap":
+                t.join(10)
+            res["compiles_during"] = \
+                (wd.compile_count - c0) if wd else 0
+        finally:
+            srv.drain(10)
+            srv.close()
+        results[phase] = (res, swap_stats)
+
+    steady, _ = results["steady"]
+    swap, sstats = results["swap"]
+    row = {"kind": "serving", "mode": "hot_swap", "model": "bench",
+           "rate": float(rate),
+           "p99_steady_ms": round(pctl(steady["lats"], 99) * 1e3, 3),
+           "p99_swap_ms": round(pctl(swap["lats"], 99) * 1e3, 3),
+           "p50_swap_ms": round(pctl(swap["lats"], 50) * 1e3, 3),
+           "offered": swap["offered"], "completed": swap["completed"],
+           "dropped": swap["errors"], "rejected": swap["rejected"],
+           "shed": swap["shed"],
+           "recompiles": int(swap.get("compiles_during", 0)),
+           "swap_aliased": int(sstats.get("aliased", 0)),
+           "swap_updated": int(sstats.get("updated", 0)),
+           "swap_seconds": sstats.get("seconds", 0.0)}
+    emit_row(row)
+    return row
 
 
 def pctl(vals, p):
@@ -261,6 +392,17 @@ def main():
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="per-request queue deadline in --open-loop "
                          "(0 = no shedding)")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="ISSUE 14 row: artifact-warmed replica start "
+                         "(deserialize) vs compile-from-scratch")
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="ISSUE 14 row: open-loop p99 across a live "
+                         "publish_weights flip vs steady state")
+    ap.add_argument("--artifact-dir", type=str, default=None,
+                    help="persist --cold-start artifacts here instead "
+                         "of a throwaway temp dir")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="offered rate (req/s) for --hot-swap")
     args = ap.parse_args()
 
     import jax
@@ -269,6 +411,40 @@ def main():
     net = build_net(args.in_dim, args.hidden, args.out_dim)
     xs = np.random.RandomState(0).rand(
         args.requests, args.in_dim).astype(np.float32)
+
+    if args.cold_start:
+        row = run_cold_start(net, (args.in_dim,), buckets,
+                             args.artifact_dir)
+        print(f"serving bench (cold start) — backend="
+              f"{jax.default_backend()} net={args.in_dim}x{args.hidden}"
+              f"x{args.out_dim} buckets={len(buckets)}")
+        print(f"  compile warmup (serial)   : "
+              f"{row['compile_serial_s'] * 1e3:9.1f} ms")
+        print(f"  compile warmup (parallel) : "
+              f"{row['compile_parallel_s'] * 1e3:9.1f} ms")
+        print(f"  artifact warmup           : "
+              f"{row['artifact_s'] * 1e3:9.1f} ms   "
+              f"({row['artifact_compiles']} compiles, "
+              f"{row['artifact_hits']} deserialized)")
+        print(f"  speedup vs compile        : "
+              f"{row['speedup_vs_compile']:9.2f}x   "
+              f"(vs serial {row['speedup_vs_serial']:.2f}x)")
+        return
+
+    if args.hot_swap:
+        row = run_hot_swap(net, xs, args.rate, args.duration,
+                           args.wait_ms, buckets, args.hidden,
+                           args.out_dim)
+        print(f"serving bench (hot swap) — backend="
+              f"{jax.default_backend()} rate={row['rate']:.0f} rps "
+              f"duration={args.duration}s")
+        print(f"  p99 steady : {row['p99_steady_ms']:9.2f} ms")
+        print(f"  p99 w/flip : {row['p99_swap_ms']:9.2f} ms   "
+              f"(aliased {row['swap_aliased']}, updated "
+              f"{row['swap_updated']}, flip {row['swap_seconds']*1e3:.1f} ms)")
+        print(f"  dropped {row['dropped']}  rejected {row['rejected']}  "
+              f"shed {row['shed']}  recompiles {row['recompiles']}")
+        return
 
     if args.open_loop:
         rates = [float(r) for r in args.rates.split(",")]

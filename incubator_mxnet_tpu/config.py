@@ -329,6 +329,34 @@ config.register(
     "batch is force-closed (warned + counted in "
     "mxtpu_serving_forced_close_total) so shutdown can never hang.")
 config.register(
+    "MXTPU_SERVING_ARTIFACT_DIR", "", str,
+    "Root directory of the persistent AOT executable artifact store "
+    "(docs/SERVING.md 'Model registry & persistent artifacts'): every "
+    "serving executor cache persists its compiled executables here and "
+    "warms by DESERIALIZING them on later boots — seconds instead of "
+    "per-bucket recompiles, zero post-load XLA compiles. Artifacts are "
+    "guarded by a (jax/jaxlib version, backend, device kind/topology, "
+    "model fingerprint) fingerprint; any mismatch refuses the artifact "
+    "and falls back to compile-and-repersist. Empty (default) disables "
+    "persistence.")
+config.register(
+    "MXTPU_SERVING_WARMUP_THREADS", 0, int,
+    "Thread-pool size for first-boot serving warmup compiles (XLA "
+    "compilation releases the GIL, so bucket compiles scale with "
+    "cores). 0 (default) = one thread per core; 1 = serial. Artifact "
+    "deserialization ignores this (it is already milliseconds).")
+config.register(
+    "MXTPU_REGISTRY_BUDGET_MB", 0.0, float,
+    "Device-memory budget (MiB) of a serving.ModelRegistry: resident "
+    "models' params + KV caches must fit it, idle models are "
+    "LRU-evicted to make room (re-admitted warm from the artifact "
+    "store on next use; in-flight models are never evicted). "
+    "0 (default) = unlimited.")
+config.register(
+    "MXTPU_REGISTRY_MAX_RESIDENT", 0, int,
+    "Cap on models resident in a serving.ModelRegistry at once, "
+    "independent of the byte budget. 0 (default) = unlimited.")
+config.register(
     "MXTPU_CHAOS", "", str,
     "JSON fault plan for the resilience chaos harness, e.g. "
     '\'{"seed": 0, "sites": {"step": {"at_calls": [7]}}}\' — applied '

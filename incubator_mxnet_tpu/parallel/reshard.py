@@ -579,6 +579,7 @@ def _t_budget():
 def load_dense_arrays(prefix: str, groups: Sequence[str] = ("param",
                                                             "frozen"),
                       manifest: Optional[Dict[str, Any]] = None,
+                      names: Optional[Sequence[str]] = None,
                       ) -> Dict[str, np.ndarray]:
     """Assemble the ``param/`` + ``frozen/`` tensors of a sharded
     training checkpoint as plain host arrays keyed by structural name —
@@ -589,7 +590,11 @@ def load_dense_arrays(prefix: str, groups: Sequence[str] = ("param",
     bulk of the bytes — integrity of the loaded groups is proven inline
     instead: each shard read here IS the full stored member, so its
     crc32 is checked against the manifest as it streams through, plus
-    full coverage per tensor)."""
+    full coverage per tensor).
+
+    ``names`` restricts the read to those stripped structural names (a
+    live weight hot-swap loads only the tensors the serving graph
+    consumes — the rest of the checkpoint's bytes are never read)."""
     import zlib
 
     from .checkpoint import CheckpointError, _load_manifest
@@ -599,9 +604,12 @@ def load_dense_arrays(prefix: str, groups: Sequence[str] = ("param",
     reader = ShardReaderCache(prefix)
     out: Dict[str, np.ndarray] = {}
     try:
+        want = None if names is None else {str(n) for n in names}
         for name, entry in manifest["tensors"].items():
             group, _, stripped = name.partition("/")
             if group not in groups:
+                continue
+            if want is not None and stripped not in want:
                 continue
             shape = tuple(entry["shape"])
             full = np.empty(shape, np.dtype(entry["dtype"]))

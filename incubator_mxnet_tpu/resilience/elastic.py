@@ -23,6 +23,17 @@ same global sample position; RNG state rides ``meta.json``), the merged
 loss stream across incarnations equals the uninterrupted run's —
 ``tools/chaos_soak.py --elastic`` asserts exactly this, shrinking both
 the mesh and the simulated input rank count mid-run.
+
+The serving-tier analog of an incarnation is a replica restart — and
+since ISSUE 14 it no longer pays the recompile either: point
+``MXTPU_SERVING_ARTIFACT_DIR`` at a persistent directory and every
+rebuilt ``ModelServer``/``DecodeSession`` (``from_checkpoint`` after a
+crash, a registry re-admission, a chaos-restore) warms its executor
+caches by DESERIALIZING the previous incarnation's compiled artifacts
+— zero post-load XLA compiles, provided the topology fingerprint still
+matches (a mesh that shrank recompiles exactly the stale entries and
+repersists them; see docs/RESILIENCE.md "Elastic restart" and
+docs/SERVING.md "Model registry & persistent artifacts").
 """
 
 from __future__ import annotations

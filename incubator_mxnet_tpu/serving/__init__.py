@@ -23,20 +23,40 @@ the C predict API, and MXNet Model Server, rebuilt TPU-native:
   join/leave at step boundaries with zero recompiles; tokens stream
   through ``DecodeHandle``; ``DecodeMetrics`` is its ``mxtpu_decode_*``
   telemetry family (docs/SERVING.md "Continuous batching").
+* ``ArtifactStore`` — the persistent AOT executable cache (ISSUE 14):
+  compiled executables serialize to disk keyed by (model fingerprint,
+  bucket, signature, topology, jaxlib/backend version); a replica warms
+  by DESERIALIZING — seconds instead of per-bucket recompiles, zero
+  post-load XLA compiles. Stale fingerprints are refused and fall back
+  to compile-and-repersist.
+* ``ModelRegistry`` — N models behind one routing front door within
+  one device-memory budget: LRU eviction of idle models (never
+  in-flight ones; re-admission warms from artifacts), per-model SLO
+  admission control, and live weight hot-swap without drain
+  (``publish_weights`` — zero-copy buffer aliasing across versions,
+  atomic old-or-new flips between batches / decode steps).
 """
 
+from .artifacts import (ArtifactStore, environment_fingerprint,
+                        params_fingerprint)
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       ServerClosedError)
 from .decode import DecodeHandle, DecodeSession, KVCache
 from .executor_cache import (DEFAULT_BUCKETS, BucketedExecutorCache,
-                             block_apply_fn, pure_method_runner)
-from .metrics import DecodeMetrics, ServingMetrics
-from .server import ModelServer, load_block_checkpoint
+                             block_apply_fn, pure_method_runner,
+                             stage_weight_swap)
+from .metrics import DecodeMetrics, RegistryMetrics, ServingMetrics
+from .registry import ModelRegistry
+from .server import (ModelServer, load_block_checkpoint,
+                     load_weight_arrays)
 
 __all__ = [
-    "BucketedExecutorCache", "DEFAULT_BUCKETS", "DeadlineExceededError",
-    "DecodeHandle", "DecodeMetrics", "DecodeSession", "DynamicBatcher",
-    "KVCache", "ModelServer", "QueueFullError", "ServerClosedError",
-    "ServingMetrics", "block_apply_fn", "load_block_checkpoint",
-    "pure_method_runner",
+    "ArtifactStore", "BucketedExecutorCache", "DEFAULT_BUCKETS",
+    "DeadlineExceededError", "DecodeHandle", "DecodeMetrics",
+    "DecodeSession", "DynamicBatcher", "KVCache", "ModelRegistry",
+    "ModelServer", "QueueFullError", "RegistryMetrics",
+    "ServerClosedError", "ServingMetrics", "block_apply_fn",
+    "environment_fingerprint", "load_block_checkpoint",
+    "load_weight_arrays", "params_fingerprint", "pure_method_runner",
+    "stage_weight_swap",
 ]

@@ -126,6 +126,19 @@ class DynamicBatcher:
         with self._cv:
             return len(self._queue)
 
+    def estimated_wait_s(self) -> float:
+        """Queue-wait estimate for a request submitted NOW: full batches
+        ahead of it times the observed batch service time (0 while the
+        backlog fits the next flush). The registry's per-model SLO
+        admission control compares this against the model's deadline —
+        a request that would already be late is rejected at the front
+        door instead of aging in the queue (``DeadlineExceededError``
+        layered above the in-queue shedding)."""
+        with self._cv:
+            batches_ahead = len(self._queue) // self.max_batch_size
+            return batches_ahead * (self._ewma_batch_s
+                                    or self.max_wait_ms / 1e3)
+
     # -- worker side ----------------------------------------------------------
     def _next_batch(self) -> Optional[List[Tuple]]:
         """Block until the flush policy yields a batch; None = exit."""

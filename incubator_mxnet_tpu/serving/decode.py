@@ -51,6 +51,8 @@ import numpy as np
 
 from .. import profiler
 from .. import telemetry
+from .artifacts import (ArtifactStore, environment_fingerprint,
+                        params_fingerprint, serialization_supported)
 from .batcher import (DeadlineExceededError, QueueFullError,
                       ServerClosedError)
 from .executor_cache import (BucketedExecutorCache,
@@ -257,7 +259,9 @@ class DecodeSession:
                  max_queue: int = 64, name: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
                  donate: Optional[bool] = None,
-                 max_new_tokens: Optional[int] = None):
+                 max_new_tokens: Optional[int] = None,
+                 artifact_dir: Optional[str] = None,
+                 model_version: str = ""):
         from ..config import config
 
         self.name = name or (getattr(block, "name", "") or "gpt")
@@ -296,7 +300,13 @@ class DecodeSession:
             self._prefill_apply, self._params, buckets=buckets,
             donate=donate, name=f"{self.name}.prefill",
             metrics=ServingMetrics(f"{self.name}.prefill"),
-            pass_count=True, depad=False)
+            pass_count=True, depad=False, artifact_dir=artifact_dir,
+            model_version=model_version)
+        # same collect_params walk the param values were zipped from
+        # (pure_method_runner exports it) — the hot-swap name→position
+        # mapping must never come from a second traversal
+        self._param_names = list(self._run.param_names)
+        self._prefill.param_names = self._param_names
 
         dtype = self._params[0].dtype
         self._kv = KVCache(block.num_layers, max_slots, block.num_heads,
@@ -309,6 +319,27 @@ class DecodeSession:
         self._joins: dict = {}
         self._dec_ex = None
         self._compile_lock = threading.Lock()
+        # persistent artifacts for the join + decode executables (the
+        # prefill cache manages its own); the engine metrics carry
+        # their compile-vs-deserialize split under <name>.engine
+        if artifact_dir is None:
+            artifact_dir = str(
+                config.get("MXTPU_SERVING_ARTIFACT_DIR") or "")
+        self._store = ArtifactStore(artifact_dir) \
+            if artifact_dir and serialization_supported() else None
+        self._guard = dict(
+            environment_fingerprint(), model=self.name,
+            fingerprint=params_fingerprint(self._params),
+            version=str(model_version), donate=self._donate,
+            kv_shape=tuple(self._kv.shape),
+            kv_dtype=self._kv.dtype.name)
+        self.engine_metrics = ServingMetrics(f"{self.name}.engine")
+        # live weight hot-swap: publishers stage off the hot path; the
+        # scheduler flips the staged version in BETWEEN steps
+        self._pending_swap: Optional[dict] = None
+        self._param_digests: Optional[List[str]] = None
+        self._weights_version: object = 0
+        self._swap_lock = threading.Lock()
 
         # host mirrors of the device cache state — fully determined by
         # scheduler actions, so they are inputs each step, never fetched
@@ -359,6 +390,35 @@ class DecodeSession:
                                    k, v, cache_len)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k2, v2
 
+    def _load_or_compile(self, logical: dict, compile_fn):
+        """Artifact-or-compile for one engine executable (caller holds
+        ``_compile_lock``): a guard-matching artifact deserializes (no
+        XLA compile — the cold-start win), anything else compiles and
+        repersists. Accounting lands in ``engine_metrics``."""
+        self.engine_metrics.cache_miss()
+        if self._store is not None:
+            t0 = time.perf_counter()
+            ex, reason = self._store.load(self.name, logical, self._guard)
+            if ex is not None:
+                self.engine_metrics.observe_deserialize(
+                    time.perf_counter() - t0)
+                return ex
+            self.engine_metrics.artifact_miss(
+                refused=reason.startswith("refused"))
+        telemetry.note_cache_miss(f"decode.{self.name}",
+                                  detail=str(logical.get("component")))
+        t0 = time.perf_counter()
+        with profiler.scope(f"decode::{self.name}::compile"):
+            ex = compile_fn()
+        self.engine_metrics.observe_compile(time.perf_counter() - t0)
+        if self._store is not None:
+            try:
+                self._store.save(self.name, logical, self._guard, ex)
+            except Exception as e:   # noqa: BLE001 — persistence only
+                logger.warning("artifact persist failed for %s %s: %s",
+                               self.name, logical, e)
+        return ex
+
     def _join_exec(self, bucket: int):
         """The per-bucket cache-join executable: writes a prefilled
         ``[L, H, Lb, D]`` plane into slot ``slot``'s cache range at
@@ -372,45 +432,55 @@ class DecodeSession:
             if ex is not None:
                 return ex
 
-            def join(kc, vc, kp, vp, slot):
-                at = (0, slot, 0, 0, 0)
-                return (jax.lax.dynamic_update_slice(kc, kp[:, None], at),
-                        jax.lax.dynamic_update_slice(vc, vp[:, None], at))
+            def compile_join():
+                def join(kc, vc, kp, vp, slot):
+                    at = (0, slot, 0, 0, 0)
+                    return (jax.lax.dynamic_update_slice(kc, kp[:, None],
+                                                         at),
+                            jax.lax.dynamic_update_slice(vc, vp[:, None],
+                                                         at))
 
-            l, s, h, t, d = self._kv.shape
-            cache = jax.ShapeDtypeStruct(self._kv.shape, self._kv.dtype)
-            plane = jax.ShapeDtypeStruct((l, h, bucket, d), self._kv.dtype)
-            slot = jax.ShapeDtypeStruct((), jnp.int32)
-            telemetry.note_cache_miss(f"decode.{self.name}",
-                                      detail=f"join bucket={bucket}")
-            with profiler.scope(f"decode::{self.name}::compile"):
+                l, s, h, t, d = self._kv.shape
+                cache = jax.ShapeDtypeStruct(self._kv.shape,
+                                             self._kv.dtype)
+                plane = jax.ShapeDtypeStruct((l, h, bucket, d),
+                                             self._kv.dtype)
+                slot = jax.ShapeDtypeStruct((), jnp.int32)
                 jitted = jax.jit(join, donate_argnums=(0, 1)
                                  if self._donate else ())
-                ex = jitted.lower(cache, cache, plane, plane,
-                                  slot).compile()
+                return jitted.lower(cache, cache, plane, plane,
+                                    slot).compile()
+
+            ex = self._load_or_compile(
+                {"component": "join", "bucket": int(bucket)},
+                compile_join)
             self._joins[bucket] = ex
             return ex
 
     def _decode_exec(self):
-        """THE decode executable — compiled once; serves every mix of
-        sequence ages and slot occupancies with zero recompiles."""
+        """THE decode executable — built once (deserialized where a
+        warm artifact exists); serves every mix of sequence ages and
+        slot occupancies with zero recompiles."""
         if self._dec_ex is not None:
             return self._dec_ex
         with self._compile_lock:
             if self._dec_ex is not None:
                 return self._dec_ex
-            cache = jax.ShapeDtypeStruct(self._kv.shape, self._kv.dtype)
-            vec = jax.ShapeDtypeStruct((self.max_slots,), jnp.int32)
-            telemetry.note_cache_miss(f"decode.{self.name}",
-                                      detail="decode")
-            with profiler.scope(f"decode::{self.name}::compile"):
+
+            def compile_decode():
+                cache = jax.ShapeDtypeStruct(self._kv.shape,
+                                             self._kv.dtype)
+                vec = jax.ShapeDtypeStruct((self.max_slots,), jnp.int32)
                 jitted = jax.jit(self._decode_apply,
                                  donate_argnums=(1, 2)
                                  if self._donate else ())
                 p_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
                            for p in self._params]
-                self._dec_ex = jitted.lower(p_specs, cache, cache, vec,
-                                            vec).compile()
+                return jitted.lower(p_specs, cache, cache, vec,
+                                    vec).compile()
+
+            self._dec_ex = self._load_or_compile(
+                {"component": "decode"}, compile_decode)
             return self._dec_ex
 
     def _decode_flops(self) -> Optional[float]:
@@ -433,14 +503,163 @@ class DecodeSession:
             self._prefill.executable(bucket, (), "int32"))
 
     def warmup(self) -> None:
-        """Compile the ENTIRE executable set ahead of traffic: every
-        prefill bucket, every join, and the decode program. After this,
-        steady-state serving performs zero compiles — the recompile
-        contract tests/test_decode.py pins under the armed watchdog."""
+        """Build the ENTIRE executable set ahead of traffic: every
+        prefill bucket, every join, and the decode program —
+        deserialized from the artifact store where warm, compiled (and
+        persisted) where not. After this, steady-state serving performs
+        zero compiles — the recompile contract tests/test_decode.py
+        pins under the armed watchdog."""
+        t0 = time.perf_counter()
+        c0 = (self._prefill.metrics.compiles
+              + self.engine_metrics.compiles)
+        a0 = (self._prefill.metrics.artifact_hits
+              + self.engine_metrics.artifact_hits)
+        self._prefill.warmup((), "int32")
         for b in self._prefill.buckets:
-            self._prefill.executable(b, (), "int32")
             self._join_exec(b)
         self._decode_exec()
+        dt = time.perf_counter() - t0
+        self.engine_metrics.observe_warmup(dt)
+        telemetry.jsonl_emit({
+            "kind": "registry", "event": "warmup", "model": self.name,
+            "seconds": round(dt, 4),
+            "buckets": len(self._prefill.buckets),
+            "compiles": (self._prefill.metrics.compiles
+                         + self.engine_metrics.compiles) - c0,
+            "deserialized": (self._prefill.metrics.artifact_hits
+                             + self.engine_metrics.artifact_hits) - a0})
+
+    def save_artifacts(self, directory: Optional[str] = None) -> int:
+        """Persist the full executable set (prefill buckets, joins, the
+        decode program) so the next replica warms by deserializing;
+        returns the artifact count written."""
+        if directory is None and self._store is None:
+            raise RuntimeError(
+                "no artifact store configured: pass artifact_dir= (or "
+                "set MXTPU_SERVING_ARTIFACT_DIR), or pass an explicit "
+                "directory")
+        store = self._store if directory is None \
+            else ArtifactStore(directory)
+        # the prefill cache shares the same artifact_dir, so its store
+        # is configured exactly when ours is
+        n = self._prefill.save_artifacts(directory)
+        with self._compile_lock:
+            joins = dict(self._joins)
+            dec = self._dec_ex
+        for bucket, ex in joins.items():
+            store.save(self.name, {"component": "join",
+                                   "bucket": int(bucket)},
+                       self._guard, ex)
+            n += 1
+        if dec is not None:
+            store.save(self.name, {"component": "decode"},
+                       self._guard, dec)
+            n += 1
+        return n
+
+    # -- live weight hot-swap (ISSUE 14) --------------------------------------
+    @property
+    def weights_version(self):
+        """Version tag of the live weights (0 until the first
+        :meth:`publish_weights`)."""
+        return self._weights_version
+
+    def publish_weights(self, source, version=None,
+                        allow_partial: bool = True,
+                        timeout: Optional[float] = 30.0) -> dict:
+        """Publish a new weight version into the LIVE session — no
+        drain, no recompile, nothing dropped. The checkpoint read
+        (dict / sharded prefix through the PR 7 slice reader / native
+        ``.params``), content digesting, and device_put of changed
+        params all happen HERE, on the publisher's thread, while
+        decoding continues; the staged version is then flipped in by
+        the scheduler BETWEEN decode steps — every prefill and every
+        step runs under exactly one version. In-flight sequences keep
+        their KV cache (computed under the old weights) and continue
+        under the new ones from the next step; sequences finished
+        before the flip are pure old-version streams, sequences
+        admitted after it pure new-version streams.
+
+        Blocks until the scheduler applies the swap (``timeout``);
+        returns the swap stats. On timeout the staged swap is WITHDRAWN
+        (a publish reported failed can never flip in later)."""
+        from .server import (_emit_swap_record, _resolve_version,
+                             _stage_publish)
+
+        with self._swap_lock:
+            t0 = time.perf_counter()
+            staged = _stage_publish(self._params, self._param_digests,
+                                    self._param_names, source,
+                                    allow_partial, self.name)
+            version = _resolve_version(self._weights_version, version)
+            applied = threading.Event()
+            swap = {"staged": staged, "version": version,
+                    "applied": applied}
+            with self._cv:
+                if self._state != "running":
+                    raise ServerClosedError(
+                        f"decode session is {self._state}; not "
+                        "accepting a weight publish")
+                self._pending_swap = swap
+                self._cv.notify_all()
+            if not applied.wait(timeout):
+                with self._cv:
+                    if self._pending_swap is swap:
+                        # withdraw: the scheduler never saw it, and a
+                        # failed publish must not flip in later
+                        self._pending_swap = None
+                        raise TimeoutError(
+                            "weight swap staged but not applied in "
+                            "time (is the scheduler thread alive?)")
+                # lost the race: the scheduler applied it after the
+                # wait expired — the publish DID land; fall through
+            with self._cv:
+                if self._state == "closed" \
+                        and self._weights_version != version:
+                    raise ServerClosedError(
+                        "decode session closed before the staged swap "
+                        "was applied")
+            dt = time.perf_counter() - t0
+        stats = dict(staged.stats)
+        stats["version"] = version
+        stats["seconds"] = round(dt, 4)
+        self.engine_metrics.observe_swap()
+        _emit_swap_record(self.name, stats)
+        return stats
+
+    def _apply_pending_swap_locked(self) -> None:
+        """Flip a staged weight version live (scheduler thread, under
+        ``_cv``, between decode steps — the step-boundary atomicity
+        contract)."""
+        swap = self._pending_swap
+        if swap is None:
+            return
+        self._pending_swap = None
+        self._params = swap["staged"].params
+        self._param_digests = swap["staged"].digests
+        # the prefill cache holds its own parameter list (it is a
+        # standalone BucketedExecutorCache): flip it at the SAME step
+        # boundary so a prefill and the decode steps that follow it can
+        # never run under different versions
+        self._prefill._params = swap["staged"].params
+        self._prefill._digests = swap["staged"].digests
+        self._weights_version = swap["version"]
+        swap["applied"].set()
+
+    def resident_bytes(self) -> int:
+        """Device bytes this session pins (params + the KV cache) —
+        the registry's budget accounting."""
+        return (sum(int(p.nbytes) for p in self._params)
+                + int(self._kv.nbytes))
+
+    def estimated_wait_s(self) -> float:
+        """Queue-wait estimate for a NEW request (0 while a slot is
+        free and nothing queues) — the registry's SLO admission
+        signal."""
+        with self._cv:
+            if self._free and not self._pending:
+                return 0.0
+            return self._retry_after_locked()
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -548,9 +767,15 @@ class DecodeSession:
     def _wait_for_work(self):
         """Block until there is something to do. Returns
         ``(admissions, shed)`` — admissions is None when the worker
-        should exit (closed, or drained dry)."""
+        should exit (closed, or drained dry). A staged weight swap is
+        applied here, on the scheduler thread between decode steps —
+        the step-boundary atomicity the hot-swap contract needs (every
+        prefill and every decode step runs under exactly one weight
+        version; the KV cache carries over, so an in-flight sequence
+        continues under the new weights next step)."""
         with self._cv:
             while True:
+                self._apply_pending_swap_locked()
                 if self._state == "closed":
                     return None, []
                 n_active = sum(1 for s in self._slots if s is not None)
@@ -722,6 +947,9 @@ class DecodeSession:
             active = [s for s in self._slots if s is not None]
             self._slots = [None] * self.max_slots
             self._free = deque(range(self.max_slots))
+            swap, self._pending_swap = self._pending_swap, None
+            if swap is not None:
+                swap["applied"].set()   # waiting publisher fails fast
             self._cv.notify_all()
         for req in pending:
             req.handle._fail(ServerClosedError("decode session closed"))
@@ -767,6 +995,10 @@ class DecodeSession:
         snap["prefill_buckets"] = list(self._prefill.buckets)
         snap["prefill_cache"] = self._prefill.metrics.snapshot()[
             "executor_cache"]
+        snap["engine_cache"] = self.engine_metrics.snapshot()[
+            "executor_cache"]
+        snap["warmup_seconds"] = self.engine_metrics.warmup_seconds
+        snap["weights_version"] = self._weights_version
         snap["max_len"] = self.max_len
         if self._meter.ema_seconds is not None:
             snap["step_ema_ms"] = self._meter.ema_seconds * 1e3

@@ -61,6 +61,12 @@ class ServingMetrics:
         self.cache_misses = 0
         self.compiles = 0
         self.compile_seconds = 0.0
+        self.artifact_hits = 0       # executables deserialized from disk
+        self.artifact_misses = 0     # no (usable) artifact: compiled
+        self.artifact_refused = 0    # artifact present but guard-mismatched
+        self.deserialize_seconds = 0.0
+        self.warmup_seconds = 0.0    # last warmup() wall time
+        self.swaps = 0               # weight versions published
         self.queue_depth = 0
         self._c_depth = profiler.counter(f"serving/{model}/queue_depth")
         self._c_batch = profiler.counter(f"serving/{model}/batch_size")
@@ -101,6 +107,33 @@ class ServingMetrics:
         self._t_compile_s = telemetry.counter(
             "mxtpu_serving_compile_seconds_total",
             "time spent compiling executors", **lbl)
+        # persistent-artifact cache (ISSUE 14): the cold-start split —
+        # every warmed executable either deserialized (artifact hit) or
+        # compiled (artifact miss; 'refused' = present but stale)
+        self._t_art_hits = telemetry.counter(
+            "mxtpu_serving_artifact_hits_total",
+            "executables deserialized from the persistent artifact "
+            "store instead of compiled", **lbl)
+        self._t_art_misses = telemetry.counter(
+            "mxtpu_serving_artifact_misses_total",
+            "executor-cache misses with no usable artifact (compiled)",
+            **lbl)
+        self._t_art_refused = telemetry.counter(
+            "mxtpu_serving_artifact_refused_total",
+            "artifacts refused on a guard-fingerprint mismatch (wrong "
+            "jaxlib/backend/topology/model fingerprint)", **lbl)
+        self._t_deser_s = telemetry.counter(
+            "mxtpu_serving_deserialize_seconds_total",
+            "time spent deserializing artifact executables", **lbl)
+        self._t_warmup_s = telemetry.gauge(
+            "mxtpu_serving_warmup_seconds",
+            "wall time of the last warmup() — the cold-start cost "
+            "(compare against compile_seconds/deserialize_seconds for "
+            "the compile-vs-artifact split)", **lbl)
+        self._t_swaps = telemetry.counter(
+            "mxtpu_serving_weight_swaps_total",
+            "weight versions published into the live server "
+            "(hot swaps, no drain)", **lbl)
 
     # -- batcher-side observations -------------------------------------------
     def observe_queue_depth(self, depth: int) -> None:
@@ -161,6 +194,39 @@ class ServingMetrics:
         self._t_compiles.inc()
         self._t_compile_s.inc(seconds)
 
+    def observe_deserialize(self, seconds: float) -> None:
+        """An executable came off the persistent artifact store (no
+        XLA compile happened)."""
+        with self._lock:
+            self.artifact_hits += 1
+            self.deserialize_seconds += seconds
+        self._t_art_hits.inc()
+        self._t_deser_s.inc(seconds)
+
+    def artifact_miss(self, refused: bool = False) -> None:
+        """No usable artifact for a missed signature: the cache fell
+        back to compile (and will repersist). ``refused`` marks the
+        stale-fingerprint case — an artifact existed but its guard
+        (jaxlib/backend/topology/model fingerprint) mismatched."""
+        with self._lock:
+            self.artifact_misses += 1
+            if refused:
+                self.artifact_refused += 1
+        self._t_art_misses.inc()
+        if refused:
+            self._t_art_refused.inc()
+
+    def observe_warmup(self, seconds: float) -> None:
+        with self._lock:
+            self.warmup_seconds = seconds
+        self._t_warmup_s.set(seconds)
+
+    def observe_swap(self) -> None:
+        """A new weight version was published into the live server."""
+        with self._lock:
+            self.swaps += 1
+        self._t_swaps.inc()
+
     # -- reads ----------------------------------------------------------------
     def latency_ms(self, p: float) -> float:
         """Latency percentile in milliseconds over the sliding window."""
@@ -189,10 +255,17 @@ class ServingMetrics:
             "batch_occupancy": occ,
             "latency_ms": {f"p{p}": _percentile(vals, p) * 1e3
                            for p in (50, 90, 99)},
+            "warmup_seconds": self.warmup_seconds,
+            "swaps": self.swaps,
             "executor_cache": {"hits": self.cache_hits,
                                "misses": self.cache_misses,
                                "compiles": self.compiles,
-                               "compile_seconds": self.compile_seconds},
+                               "compile_seconds": self.compile_seconds,
+                               "artifact_hits": self.artifact_hits,
+                               "artifact_misses": self.artifact_misses,
+                               "artifact_refused": self.artifact_refused,
+                               "deserialize_seconds":
+                                   self.deserialize_seconds},
         }
 
 
@@ -365,4 +438,113 @@ class DecodeMetrics:
                 "decode_seconds": self.decode_seconds,
                 "prefill_frac":
                     (self.prefill_seconds / total) if total else 0.0,
+            }
+
+
+class RegistryMetrics:
+    """Registry-level serving metrics (ISSUE 14): the ``mxtpu_registry_*``
+    family — resident-model and budget gauges plus per-model admission /
+    eviction / SLO-rejection / weight-swap counters, mirrored into the
+    shared telemetry registry like every other serving family. Local
+    ints stay authoritative for ``snapshot()`` (work with telemetry
+    disabled); per-model telemetry counters are created lazily on first
+    observation (the shared registry dedupes by (name, labels))."""
+
+    def __init__(self, registry: str = "registry"):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self.admissions = 0
+        self.cold_admissions = 0     # built by compile (no warm artifacts)
+        self.evictions = 0
+        self.slo_rejections = 0
+        self.swaps = 0
+        self.resident = 0
+        self.resident_bytes = 0
+        self.budget_bytes = 0
+        self.per_model: Dict[str, Dict[str, int]] = {}
+        lbl = {"registry": registry}
+        self._g_resident = telemetry.gauge(
+            "mxtpu_registry_models_resident",
+            "models currently holding device memory in this registry",
+            **lbl)
+        self._g_bytes = telemetry.gauge(
+            "mxtpu_registry_resident_bytes",
+            "device bytes attributed to resident models "
+            "(params + KV caches)", **lbl)
+        self._g_budget = telemetry.gauge(
+            "mxtpu_registry_budget_bytes",
+            "configured device-memory budget (0 = unlimited)", **lbl)
+
+    def _bump(self, model: str, key: str) -> None:
+        with self._lock:
+            slot = self.per_model.setdefault(
+                model, {"admissions": 0, "evictions": 0,
+                        "slo_rejections": 0, "swaps": 0})
+            slot[key] += 1
+
+    def _counter(self, name: str, help: str, model: str):
+        return telemetry.counter(name, help, registry=self.registry,
+                                 model=model)
+
+    def observe_admit(self, model: str, cold: bool) -> None:
+        with self._lock:
+            self.admissions += 1
+            if cold:
+                self.cold_admissions += 1
+        self._bump(model, "admissions")
+        self._counter("mxtpu_registry_admissions_total",
+                      "models admitted (built/rebuilt) into the registry",
+                      model).inc()
+
+    def observe_evict(self, model: str) -> None:
+        with self._lock:
+            self.evictions += 1
+        self._bump(model, "evictions")
+        self._counter("mxtpu_registry_evictions_total",
+                      "idle models evicted to fit the memory budget",
+                      model).inc()
+
+    def observe_slo_rejection(self, model: str) -> None:
+        with self._lock:
+            self.slo_rejections += 1
+        self._bump(model, "slo_rejections")
+        self._counter("mxtpu_registry_slo_rejections_total",
+                      "requests rejected at admission because the "
+                      "model's backlog already exceeded its deadline",
+                      model).inc()
+
+    def observe_swap(self, model: str) -> None:
+        with self._lock:
+            self.swaps += 1
+        self._bump(model, "swaps")
+        self._counter("mxtpu_registry_weight_swaps_total",
+                      "weight versions hot-swapped through the registry",
+                      model).inc()
+
+    def set_residency(self, resident: int, resident_bytes: int) -> None:
+        with self._lock:
+            self.resident = int(resident)
+            self.resident_bytes = int(resident_bytes)
+        self._g_resident.set(resident)
+        self._g_bytes.set(resident_bytes)
+
+    def set_budget(self, budget_bytes: int) -> None:
+        with self._lock:
+            self.budget_bytes = int(budget_bytes)
+        self._g_budget.set(budget_bytes)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "registry": self.registry,
+                "admissions": self.admissions,
+                "cold_admissions": self.cold_admissions,
+                "evictions": self.evictions,
+                "slo_rejections": self.slo_rejections,
+                "swaps": self.swaps,
+                "resident": self.resident,
+                "resident_bytes": self.resident_bytes,
+                "budget_bytes": self.budget_bytes,
+                "per_model": {m: dict(v)
+                              for m, v in self.per_model.items()},
             }

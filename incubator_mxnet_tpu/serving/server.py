@@ -27,6 +27,7 @@ import json
 import logging
 import os
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Optional, Sequence, Tuple
 
@@ -40,7 +41,8 @@ from .executor_cache import DEFAULT_BUCKETS, BucketedExecutorCache
 from .metrics import ServingMetrics
 
 __all__ = ["DeadlineExceededError", "ModelServer", "QueueFullError",
-           "ServerClosedError", "load_block_checkpoint"]
+           "ServerClosedError", "load_block_checkpoint",
+           "load_weight_arrays"]
 
 
 def _sharded_prefix(params_path: str) -> Optional[str]:
@@ -95,6 +97,86 @@ def load_block_checkpoint(block, params_path: str, ctx=None,
     return block
 
 
+def load_weight_arrays(source, names=None) -> dict:
+    """Resolve a weight *source* to ``{structural_name: np.ndarray}`` —
+    the block-less loader behind live weight hot-swap
+    (:meth:`ModelServer.publish_weights`). ``source`` may be
+
+    * a dict of arrays (returned as-is, keys assumed structural),
+    * a positional list/tuple of arrays (returned as-is — for caches
+      built without structural names),
+    * a sharded training-checkpoint prefix/manifest from ANY mesh —
+      the ``param/`` + ``frozen/`` tensors stream through the PR 7
+      slice-planning reader one at a time (``names`` restricts the
+      read to the parameters the model actually serves), or
+    * a native ``.params`` checkpoint (C ABI reader when available,
+      else ``nd.load``), with ``arg:``/``aux:`` prefixes stripped.
+    """
+    if isinstance(source, dict):
+        return {k: np.asarray(v) for k, v in source.items()}
+    if isinstance(source, (list, tuple)):
+        return [np.asarray(v) for v in source]
+    path = str(source)
+    sharded_prefix = _sharded_prefix(path)
+    if sharded_prefix is not None:
+        from ..parallel.reshard import load_dense_arrays
+
+        return load_dense_arrays(sharded_prefix, names=names)
+    from .. import native
+
+    if native.lib() is not None:
+        arrays = native.native_params_load(path)
+    else:
+        from ..ndarray import ndarray as _ndimpl
+
+        arrays = {k: v.asnumpy()
+                  for k, v in _ndimpl.load(path).items()}
+    out = {}
+    for k, v in arrays.items():
+        if k.startswith(("arg:", "aux:")):
+            k = k.split(":", 1)[1]
+        out[k] = np.asarray(v)
+    return out
+
+
+def _stage_publish(params, digests, param_names, source,
+                   allow_partial: bool, model: str):
+    """The shared first half of every weight publish: resolve the
+    source to arrays, drop checkpoint tensors the serving graph does
+    not consume (an explicit dict publish keeps unknown keys so staging
+    rejects typos loudly), and stage the swap — all off the hot path."""
+    from .executor_cache import stage_weight_swap
+
+    names = set(param_names or []) or None
+    arrays = load_weight_arrays(source, names=names)
+    if names is not None and isinstance(arrays, dict) \
+            and not isinstance(source, dict):
+        arrays = {k: v for k, v in arrays.items() if k in names}
+        if not arrays:
+            # a checkpoint whose tensor names match NOTHING served is a
+            # wrong-model/typo'd path, not a weight update — committing
+            # it would bump the version while the old weights keep
+            # serving, silently
+            raise ValueError(
+                f"checkpoint {source!r} contains no tensors matching "
+                f"{model}'s served parameter names "
+                f"(e.g. {sorted(names)[:3]}); wrong checkpoint?")
+    return stage_weight_swap(params, digests, param_names, arrays,
+                             allow_partial=allow_partial, model=model)
+
+
+def _resolve_version(base, version):
+    """Explicit version tag, else autobump an integer lineage."""
+    if version is not None:
+        return version
+    return (base + 1) if isinstance(base, int) else 1
+
+
+def _emit_swap_record(model: str, stats: dict) -> None:
+    telemetry.jsonl_emit({"kind": "registry", "event": "swap",
+                          "model": model, **stats})
+
+
 class ModelServer:
     """Serve one model with dynamic batching and bucketed AOT executors.
 
@@ -102,6 +184,11 @@ class ModelServer:
     already-built ``BucketedExecutorCache``. ``max_batch_size`` defaults
     to the largest bucket; it may not exceed it (a flushed batch must
     fit the biggest executable).
+
+    ``artifact_dir`` (default: the ``MXTPU_SERVING_ARTIFACT_DIR`` knob)
+    points the executor cache at a persistent artifact store: warmup
+    deserializes previously-compiled executables instead of compiling
+    (docs/SERVING.md "Model registry & persistent artifacts").
     """
 
     def __init__(self, model, buckets: Optional[Sequence[int]] = None,
@@ -109,12 +196,16 @@ class ModelServer:
                  max_wait_ms: float = 5.0, max_queue: int = 64,
                  name: Optional[str] = None,
                  donate: Optional[bool] = None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 artifact_dir: Optional[str] = None,
+                 model_version: str = ""):
         if isinstance(model, BucketedExecutorCache):
-            if buckets is not None or donate is not None:
+            if buckets is not None or donate is not None \
+                    or artifact_dir is not None:
                 raise ValueError(
-                    "buckets/donate are fixed by the prebuilt "
-                    "BucketedExecutorCache; configure them there")
+                    "buckets/donate/artifact_dir are fixed by the "
+                    "prebuilt BucketedExecutorCache; configure them "
+                    "there")
             self._cache = model
             name = name or model.name
         else:
@@ -122,7 +213,8 @@ class ModelServer:
             self._cache = BucketedExecutorCache.from_block(
                 model,
                 buckets=DEFAULT_BUCKETS if buckets is None else buckets,
-                donate=donate, name=name, metrics=ServingMetrics(name))
+                donate=donate, name=name, metrics=ServingMetrics(name),
+                artifact_dir=artifact_dir, model_version=model_version)
         self.name = name
         self.metrics: ServingMetrics = self._cache.metrics
         if max_batch_size is None:
@@ -142,6 +234,8 @@ class ModelServer:
         self._meter = telemetry.StepMeter(f"serving.{name}")
         self._maintenance = 0          # healthz unready while > 0
         self._maintenance_lock = threading.Lock()
+        self._weights_version: object = 0   # bumped by publish_weights
+        self._swap_lock = threading.Lock()  # serializes publishers only
         telemetry.maybe_start_http()
 
     # -- construction from artifacts -----------------------------------------
@@ -225,12 +319,81 @@ class ModelServer:
 
     # -- lifecycle ------------------------------------------------------------
     def warmup(self, feature_shape: Tuple[int, ...], dtype="float32",
-               buckets: Optional[Sequence[int]] = None) -> None:
-        """Compile every bucket for the given request signature before
+               buckets: Optional[Sequence[int]] = None,
+               threads: Optional[int] = None) -> None:
+        """Build every bucket for the given request signature before
         traffic arrives (cold-start compiles otherwise land on the first
-        unlucky requests), and pin the accepted signature."""
-        self._cache.warmup(tuple(feature_shape), dtype, buckets)
+        unlucky requests), and pin the accepted signature. Warm
+        artifacts deserialize; cold buckets compile across a thread
+        pool (``MXTPU_SERVING_WARMUP_THREADS``)."""
+        self._cache.warmup(tuple(feature_shape), dtype, buckets,
+                           threads=threads)
         self._batcher.expect_features(tuple(feature_shape), dtype)
+
+    # -- persistent artifacts & weight hot-swap (ISSUE 14) --------------------
+    def save_artifacts(self, directory: Optional[str] = None) -> int:
+        """Persist every compiled executable so the next replica (or
+        elastic-restart incarnation) warms by deserializing — see
+        :meth:`BucketedExecutorCache.save_artifacts`."""
+        return self._cache.save_artifacts(directory)
+
+    def load_artifacts(self, directory: Optional[str] = None) -> int:
+        """Eagerly load every guard-matching artifact of this model."""
+        return self._cache.load_artifacts(directory)
+
+    @property
+    def weights_version(self):
+        """The version tag of the live weights (0 until the first
+        :meth:`publish_weights`)."""
+        return self._weights_version
+
+    def publish_weights(self, source, version=None,
+                        allow_partial: bool = True) -> dict:
+        """Publish a new weight version into the LIVE server — no drain,
+        no recompile, zero dropped requests (the TF-Serving version-flip
+        lifecycle, arXiv:1605.08695).
+
+        ``source`` is a ``{structural_name: array}`` dict, a sharded
+        training-checkpoint prefix from ANY mesh (streamed through the
+        PR 7 slice reader, optimizer state never read), or a native
+        ``.params`` path. The heavy work — reading the checkpoint,
+        digesting, device_put of CHANGED params (unchanged ones alias
+        the resident buffers zero-copy) — happens here, off the hot
+        path, while traffic keeps flowing and ``healthz()`` stays
+        ready. Only the final pointer flip runs inside a (microseconds-
+        long) :meth:`maintenance` window, between batches: a batch in
+        flight keeps the version it read, the next batch sees the new
+        version whole — old-or-new, never a mix.
+
+        Returns the swap stats (``aliased``/``updated`` param counts,
+        ``seconds``, ``version``)."""
+        with self._swap_lock:
+            t0 = time.perf_counter()
+            staged = _stage_publish(self._cache._params,
+                                    self._cache._digests,
+                                    self._cache.param_names, source,
+                                    allow_partial, self.name)
+            with self.maintenance():
+                stats = self._cache.commit_params(staged)
+                version = _resolve_version(self._weights_version,
+                                           version)
+                self._weights_version = version
+            dt = time.perf_counter() - t0
+        stats["version"] = version
+        stats["seconds"] = round(dt, 4)
+        _emit_swap_record(self.name, stats)
+        return stats
+
+    def resident_bytes(self) -> int:
+        """Device bytes this server pins (params) — the registry's
+        budget accounting."""
+        return self._cache.param_bytes()
+
+    def estimated_wait_s(self) -> float:
+        """Current queue-wait estimate for a NEW request (0 when the
+        backlog fits one batch) — what the registry's SLO admission
+        control compares against the model's deadline."""
+        return self._batcher.estimated_wait_s()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful: refuse new requests, answer everything queued —
@@ -293,6 +456,7 @@ class ModelServer:
             "model": self.name,
             "queue_depth": self.queue_depth,
             "compiled_buckets": len(self.compiled_signatures()),
+            "weights_version": self._weights_version,
         }
 
     def __enter__(self) -> "ModelServer":
@@ -320,4 +484,5 @@ class ModelServer:
         snap = self.metrics.snapshot()
         snap["buckets"] = list(self.buckets)
         snap["compiled"] = [list(k) for k in self.compiled_signatures()]
+        snap["weights_version"] = self._weights_version
         return snap

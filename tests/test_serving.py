@@ -652,3 +652,59 @@ def test_ndim_overflow_guard(tmp_path):
         f.write(buf.getvalue())
     with pytest.raises(IOError):
         native.native_params_load(path)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellites on the batch tier
+# ---------------------------------------------------------------------------
+def test_batcher_estimated_wait_tracks_backlog():
+    """The SLO-admission signal: zero while the backlog fits the next
+    flush, then full-batches-ahead x observed service time."""
+    import threading as _th
+    import time as _time
+
+    gate = _th.Event()
+
+    def runner(batch):
+        gate.wait(10)
+        return batch * 2
+
+    b = DynamicBatcher(runner, max_batch_size=2, max_wait_ms=1.0,
+                       max_queue=64, name="wait")
+    try:
+        assert b.estimated_wait_s() == 0.0
+        fut = b.submit(np.zeros(2, np.float32))
+        gate.set()
+        fut.result(10)                     # learn the service time
+        gate.clear()
+        for _ in range(9):                 # one in flight + 8 queued
+            b.submit(np.zeros(2, np.float32))
+        _time.sleep(0.05)                  # worker picks up one batch
+        est = b.estimated_wait_s()
+        assert est > 0.0
+        gate.set()
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_positional_weight_publish_and_version_autobump():
+    """A cache built from a raw apply_fn (no structural names) still
+    hot-swaps via a positional sequence; versions auto-increment."""
+    net = _dense()
+    srv = serving.ModelServer(net, buckets=(1,), artifact_dir="")
+    try:
+        srv.warmup((4,), "float32")
+        x = np.ones(4, np.float32)
+        before = np.asarray(srv.predict(x))
+        new = [np.zeros_like(np.asarray(p))
+               for p in srv._cache._params]
+        stats = srv.publish_weights(new)
+        assert stats["version"] == 1 and srv.weights_version == 1
+        np.testing.assert_array_equal(np.asarray(srv.predict(x)),
+                                      np.zeros_like(before))
+        stats = srv.publish_weights(new)
+        assert stats["version"] == 2
+        assert stats["aliased"] == len(new)    # identical -> all aliased
+    finally:
+        srv.close()

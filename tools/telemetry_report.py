@@ -209,6 +209,29 @@ def summarize(path: str, merge: bool = False) -> str:
                 f"{toks / max(1, len(recs)):8.1f} "
                 f"{(sum(occ) / len(occ)) if occ else 0.0:10.2f} "
                 f"{_pctl(waits, 95):12.2f} {_pctl(walls, 95):12.2f}")
+    regs: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "registry":
+            regs.setdefault(r.get("model", "?"), []).append(r)
+    if regs:
+        # serving registry / persistent-artifact lifecycle (ISSUE 14):
+        # warmup rows carry the compile-vs-deserialize cold-start
+        # split; admit/evict/swap rows the residency churn
+        lines.append("")
+        lines.append(f"{'registry':24s} {'warmups':>8s} {'last s':>8s} "
+                     f"{'compiles':>9s} {'deser':>6s} {'admits':>7s} "
+                     f"{'evicts':>7s} {'swaps':>6s}")
+        for model in sorted(regs):
+            recs = regs[model]
+            warm = [r for r in recs if r.get("event") == "warmup"]
+            lines.append(
+                f"{model:24s} {len(warm):8d} "
+                f"{(warm[-1].get('seconds', 0.0) if warm else 0.0):8.3f} "
+                f"{sum(int(r.get('compiles', 0)) for r in warm):9d} "
+                f"{sum(int(r.get('deserialized', 0)) for r in warm):6d} "
+                f"{sum(1 for r in recs if r.get('event') == 'admit'):7d} "
+                f"{sum(1 for r in recs if r.get('event') == 'evict'):7d} "
+                f"{sum(1 for r in recs if r.get('event') == 'swap'):6d}")
     res = [r for r in records if r.get("kind") == "resilience"]
     if res:
         counts: Dict[str, int] = {}
@@ -338,6 +361,28 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
         occ = [r["slots_active"] for r in recs if "slots_active" in r]
         if occ:
             out[f"decode/{model}/occupancy"] = sum(occ) / len(occ)
+    # registry lifecycle records aggregate into per-model compare keys:
+    # warmup seconds + the compile-vs-deserialize split (the cold-start
+    # diff between a compile round and an artifact-warmed round), plus
+    # residency churn counts
+    reg_by_model: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "registry":
+            reg_by_model.setdefault(r.get("model", "?"), []).append(r)
+    for model, recs in reg_by_model.items():
+        base = f"registry/{model}"
+        warm = [r for r in recs if r.get("event") == "warmup"]
+        if warm:
+            out[f"{base}/warmup_s"] = float(warm[-1].get("seconds", 0.0))
+            out[f"{base}/warmup_compiles"] = float(
+                sum(int(r.get("compiles", 0)) for r in warm))
+            out[f"{base}/warmup_deserialized"] = float(
+                sum(int(r.get("deserialized", 0)) for r in warm))
+        for ev, key in (("admit", "admissions"), ("evict", "evictions"),
+                        ("swap", "swaps")):
+            n = sum(1 for r in recs if r.get("event") == ev)
+            if n:
+                out[f"{base}/{key}"] = float(n)
     n_rec: Dict[str, int] = {}
     for r in records:
         if r.get("kind") == "recompile":
