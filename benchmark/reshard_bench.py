@@ -21,9 +21,16 @@ one host every byte must still be read (all destination shards are
 local); the byte-read savings appear with multiple processes, the
 memory bound appears everywhere.
 
+``--device`` (ISSUE 15) compares the PR 7 HOST path (checkpoint
+round-trip) against the in-ICI DEVICE path
+(``parallel.migrate.migrate_trainer_state``) for a live layout flip
+over the same chips: wall time, wire bytes from the planned schedule,
+and ``peak_host_bytes`` — asserted ZERO on the device path.
+``--quant int8`` ships the migration payloads block-quantized.
+
 Standalone::
 
-    JAX_PLATFORMS=cpu python benchmark/reshard_bench.py
+    JAX_PLATFORMS=cpu python benchmark/reshard_bench.py [--device]
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_trainer(n_dev, *, seed=0, hidden=512):
+def _build_trainer(n_dev, *, seed=0, hidden=512, axes=None):
     import jax
 
     from incubator_mxnet_tpu import gluon, parallel
@@ -50,7 +57,7 @@ def _build_trainer(n_dev, *, seed=0, hidden=512):
             nn.Dense(hidden, in_units=hidden, activation="relu"),
             nn.Dense(64, in_units=hidden))
     net.initialize(init="xavier")
-    mesh = parallel.make_mesh({"data": n_dev},
+    mesh = parallel.make_mesh(dict(axes) if axes else {"data": n_dev},
                               devices=jax.devices()[:n_dev])
     trainer = parallel.SPMDTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
@@ -144,9 +151,134 @@ def compare_restore(hidden: int = 512, root: str = None):
     }
 
 
-def main():
+def compare_device(hidden: int = 512, root: str = None,
+                   quant: str = None):
+    """``--device`` mode (ISSUE 15): the PR 7 HOST path (save_sharded +
+    slice-planned restore_sharded) vs the in-ICI DEVICE path
+    (``parallel.migrate.migrate_trainer_state``) for the same layout
+    flip — a ZeRO-1 trainer's state flipping between two mesh shapes
+    over the SAME chips (``(N,)`` -> ``(N/2, 2)``), so the device path
+    runs as the one donated executable, not per-leaf transfers.
+    Reports wall time, bytes (host path: bytes read from disk; device
+    path: planned bytes-on-wire), and the peak host bytes of each —
+    asserted ZERO on the device path — plus a bit-exactness
+    cross-check of the two destinations. Rows ride the PR 4 JSONL sink
+    (``kind: "bench"``) so ``tools/telemetry_report.py --compare``
+    diffs them across rounds."""
+    import jax
+
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel import migrate as migrate_mod
+    from incubator_mxnet_tpu.parallel import reshard as reshard_mod
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        raise RuntimeError(
+            "reshard bench needs >= 2 devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 on a 1-chip host)")
+    dst_axes = {"data": max(1, n_dev // 2), "model": 2} if n_dev >= 4 \
+        else {"data": 1, "model": n_dev}
+    own_tmp = root is None
+    if own_tmp:
+        root = tempfile.mkdtemp(prefix="mxtpu-reshard-bench-")
+    prefix = os.path.join(root, "ckpt")
+
+    src = _build_trainer(n_dev, hidden=hidden)
+    x = np.random.rand(64 * n_dev, 256).astype(np.float32)
+    y = np.random.randint(0, 64, (64 * n_dev,)).astype(np.float32)
+    src.step(x, y)
+
+    # HOST path: checkpoint round-trip through the PR 7 planner
+    t0 = time.perf_counter()
+    parallel.save_sharded(prefix, src)
+    dst_host = _build_trainer(n_dev, seed=7, hidden=hidden,
+                              axes=dst_axes)
+    parallel.restore_sharded(prefix, dst_host, reshard="always")
+    import jax as _jax
+
+    _jax.block_until_ready(_jax.tree_util.tree_leaves(dst_host.params))
+    host_s = time.perf_counter() - t0
+    host_stats = reshard_mod.last_stats()
+
+    # DEVICE path: the live state flips in ICI, no file, no host buffer
+    dst_dev = _build_trainer(n_dev, seed=8, hidden=hidden,
+                             axes=dst_axes)
+    t0 = time.perf_counter()
+    migrate_mod.migrate_trainer_state(src, dst_dev, quant=quant,
+                                      donate=False, site="bench")
+    _jax.block_until_ready(_jax.tree_util.tree_leaves(dst_dev.params))
+    dev_s = time.perf_counter() - t0
+    dev_stats = migrate_mod.last_stats()
+    assert dev_stats["peak_host_bytes"] == 0, \
+        "device path materialized host bytes"
+
+    # cross-check: the two destinations agree bit-for-bit (fp path)
+    if (quant or "none") == "none":
+        for n in dst_host.params:
+            np.testing.assert_array_equal(
+                np.asarray(dst_host.params[n]),
+                np.asarray(dst_dev.params[n]), n)
+
+    if own_tmp:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    rows = {
+        "host_ms": host_s * 1e3,
+        "device_ms": dev_s * 1e3,
+        "speedup_x": host_s / dev_s if dev_s else float("nan"),
+        "host_bytes_read": int(host_stats["bytes_read"]),
+        "host_peak_host_bytes": int(host_stats["peak_host_bytes"]),
+        "device_wire_bytes": int(dev_stats["wire_bytes"]),
+        "device_fp_wire_bytes": int(dev_stats["fp_wire_bytes"]),
+        "device_peak_host_bytes": int(dev_stats["peak_host_bytes"]),
+        "device_plan_ops": int(dev_stats["plan_ops"]),
+        "device_mode": dev_stats["mode"],
+        "quant": dev_stats["quant"],
+        "devices": n_dev,
+        "src_mesh": {"data": n_dev},
+        "dst_mesh": dst_axes,
+    }
+    _emit({"kind": "bench", "metric": "reshard_device_ms",
+           "value": rows["device_ms"], "unit": "ms",
+           "wire_bytes": rows["device_wire_bytes"],
+           "peak_host_bytes": 0, "quant": rows["quant"]})
+    _emit({"kind": "bench", "metric": "reshard_host_ms",
+           "value": rows["host_ms"], "unit": "ms",
+           "bytes_read": rows["host_bytes_read"],
+           "peak_host_bytes": rows["host_peak_host_bytes"]})
+    return rows
+
+
+def _emit(record):
+    try:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.jsonl_emit(record)
+    except Exception:
+        pass
+
+
+def main(argv=None):
+    import argparse
     import json
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", action="store_true",
+                    help="device-path (in-ICI migrate) vs host-path "
+                         "(checkpoint round-trip) comparison")
+    ap.add_argument("--quant", default=None,
+                    help="--device only: migrate payload quantization "
+                         "(none/int8)")
+    args = ap.parse_args(argv)
+    if args.device:
+        out = compare_device(quant=args.quant)
+        out["metric"] = "reshard_device"
+        out["host_ms"] = round(out["host_ms"], 3)
+        out["device_ms"] = round(out["device_ms"], 3)
+        out["speedup_x"] = round(out["speedup_x"], 2)
+        print(json.dumps(out))
+        return
     out = compare_restore()
     out["metric"] = "reshard_restore"
     out["gather_ms"] = round(out["gather_ms"], 3)

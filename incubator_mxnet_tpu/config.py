@@ -391,6 +391,30 @@ config.register(
     "a surviving topology (fresh build_fn + reshard-restore) after a "
     "fatal incarnation loss before re-raising.")
 config.register(
+    "MXTPU_ELASTIC_MIGRATE", True, _parse_bool,
+    "Elastic rebuild short-circuit (docs/RESILIENCE.md 'Elastic "
+    "grow-back'): when the surviving in-memory state covers the new "
+    "topology, an ElasticRunner rebuild migrates it device-to-device "
+    "through parallel.migrate — zero host bytes, no checkpoint "
+    "round-trip — and resumes at the exact failure step (RNG + feed "
+    "position carried from the supervisor's step-boundary snapshot). "
+    "The checkpoint restore remains the fallback whenever migration is "
+    "not possible (dead buffers, structure change, non-resumable "
+    "feed). 0 forces the checkpoint path.")
+config.register(
+    "MXTPU_MIGRATE_QUANT", "none", str,
+    "Block-quantize in-ICI live-resharding payloads "
+    "(parallel/migrate.py, docs/SCALING.md 'Live resharding'): 'none' "
+    "(default) moves full-precision bytes — bit-exact; 'int8' ships "
+    "eligible floating tensors as per-block int8 codes + f32 scales "
+    "(block size MXTPU_COLLECTIVE_QUANT_BLOCK, the "
+    "collectives._quantize_rows wire format) — ~4x fewer bytes on the "
+    "wire at a bounded per-block error (max|block|/254). Tensors whose "
+    "size does not divide the block, non-float tensors, and non-moving "
+    "tensors always migrate exactly. Note: a quantized elastic resume "
+    "or ZeRO re-placement trades the bit-exact contract for wire "
+    "compression.")
+config.register(
     "MXTPU_ZERO_STAGE", 0, int,
     "Default ZeRO stage for SPMDTrainer when the zero_stage argument is "
     "unset (docs/TRAINING.md 'ZeRO ladder'): 0 replicated, 1 shards "

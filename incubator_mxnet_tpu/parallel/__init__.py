@@ -30,3 +30,6 @@ from .checkpoint import (CheckpointError, restore_sharded, save_sharded,
                          validate_sharded)
 from . import reshard
 from .reshard import ReshardEngine
+from . import migrate
+from .migrate import (MigrateError, migrate_arrays,
+                      migrate_trainer_state, serving_weights)

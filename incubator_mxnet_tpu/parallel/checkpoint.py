@@ -524,6 +524,9 @@ def restore_sharded(prefix: str, trainer, data_iter=None, *,
     # onto a stage-3 trainer with parameters sharded 1/N, a stage-3
     # save onto a stage-2 trainer replicated — and a quantized plan
     # resets error-feedback residuals saved on a different topology.
+    # The re-placement itself is device-resident by now, so the hook
+    # runs it through parallel/migrate.py — one in-ICI executable,
+    # zero host bytes (ISSUE 15) — not per-tensor device_put hops.
     # Plan-less and stage-0/1 trainers keep the recorded layout (the
     # PR 7 contract; stage-1 weights live sharded after any step
     # regardless). Values are identical either way.

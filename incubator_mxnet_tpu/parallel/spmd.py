@@ -686,12 +686,22 @@ class SPMDTrainer:
         untouched). Values are never changed; no-op without a plan or
         when layouts already agree. Stage-0/1 trainers (and plan-less
         ones) keep the checkpoint's recorded layout — stage-1 weights
-        live sharded after any step regardless."""
+        live sharded after any step regardless.
+
+        Since ISSUE 15, the device-resident re-placement runs through
+        ``parallel.migrate`` — every move lowers into ONE in-ICI
+        executable (site ``zero.placement``, ``mxtpu_migrate_*``
+        telemetry, zero host bytes) instead of per-tensor
+        ``device_put`` round-trips; the per-tensor path stays as
+        fallback."""
         plan = self.zero_plan
         if plan is None:
             return
+        from . import migrate as migrate_mod
         from . import zero as zero_mod
 
+        moves: Dict[Any, Any] = {}
+        wants: Dict[Any, Any] = {}
         if plan.stage >= 2:
             for n in list(self.params):
                 if n not in plan.eligible:
@@ -700,10 +710,50 @@ class SPMDTrainer:
                 want = NamedSharding(self.mesh, spec)
                 arr = self.params[n]
                 if not want.is_equivalent_to(arr.sharding, arr.ndim):
-                    self.params[n] = jax.device_put(arr, want)
+                    moves[("param", n)] = arr
+                    wants[("param", n)] = want
+        inner, resid = zero_mod.split_opt_state(self.opt_state)
+        leaves, treedef = jax.tree_util.tree_flatten(inner)
         if plan.stage >= 1:
-            inner, resid = zero_mod.split_opt_state(self.opt_state)
-            inner = zero_mod.shard_opt_state(plan, inner, self.params)
+            shardings = zero_mod.opt_state_shardings(plan, inner,
+                                                     self.params)
+            for i, (leaf, want) in enumerate(zip(leaves, shardings)):
+                if want is None:
+                    continue
+                cur = getattr(leaf, "sharding", None)
+                if cur is not None \
+                        and want.is_equivalent_to(cur, leaf.ndim):
+                    continue
+                moves[("opt", i)] = leaf
+                wants[("opt", i)] = want
+        if moves:
+            try:
+                # donate=False: a partial failure must leave the source
+                # arrays alive for the per-tensor fallback below.
+                # quant pinned to none: re-placement is a placement
+                # change, never a value change — a user's
+                # MXTPU_MIGRATE_QUANT (meant for elastic/serving wire
+                # compression) must not make restores lossy
+                out = migrate_mod.migrate_arrays(
+                    moves, wants, quant="none", donate=False,
+                    site="zero.placement")
+            except Exception as e:      # the slower per-tensor path is
+                # always correct; a migrate refusal must not fail a
+                # restore
+                import logging
+
+                logging.getLogger("mxtpu.zero").debug(
+                    "zero placement migrate fell back to device_put: "
+                    "%s", e)
+                out = {k: jax.device_put(v, wants[k])
+                       for k, v in moves.items()}
+            for (kind, key), arr in out.items():
+                if kind == "param":
+                    self.params[key] = arr
+                else:
+                    leaves[key] = arr
+        if plan.stage >= 1:
+            inner = jax.tree_util.tree_unflatten(treedef, leaves)
             if resid is not None:
                 resid = zero_mod.check_residuals(plan, resid)
             self.opt_state = inner if resid is None \

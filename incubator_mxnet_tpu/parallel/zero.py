@@ -375,15 +375,20 @@ def check_residuals(plan: ZeroPlan, resid: Dict[str, Any]
     return out
 
 
-def shard_opt_state(plan: ZeroPlan, opt_state, params: Dict[str, Any]):
-    """Shard optimizer-state leaves of eligible params over the axis —
-    the ZeRO-1 move (arXiv:2004.13336), shared by stages 1-3. A leaf
-    belongs to a param when the innermost dict key on its tree path is
-    the param's name and the shape matches."""
+def opt_state_shardings(plan: ZeroPlan, opt_state,
+                        params: Dict[str, Any]):
+    """Flat list (``tree_leaves`` order) of the ZeRO-1 target
+    ``NamedSharding`` per optimizer-state leaf, ``None`` for leaves
+    that keep their placement. A leaf belongs to a param when the
+    innermost dict key on its tree path is the param's name and the
+    shape matches. The one matching rule behind both placement paths:
+    :func:`shard_opt_state` (eager ``device_put``) and the in-ICI
+    ``migrate`` re-placement in ``SPMDTrainer.apply_zero_placement``."""
     shapes = {n: tuple(a.shape) for n, a in params.items()}
     eligible = plan.eligible
-
-    def reshard(path, leaf):
+    flat, _treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = []
+    for path, leaf in flat:
         name = None
         for entry in reversed(path):
             key = getattr(entry, "key", None)
@@ -392,11 +397,22 @@ def shard_opt_state(plan: ZeroPlan, opt_state, params: Dict[str, Any]):
                 break
         if (name in eligible
                 and tuple(getattr(leaf, "shape", ())) == shapes[name]):
-            return jax.device_put(leaf, NamedSharding(
-                plan.mesh, PartitionSpec(plan.axis)))
-        return leaf
+            out.append(NamedSharding(plan.mesh,
+                                     PartitionSpec(plan.axis)))
+        else:
+            out.append(None)
+    return out
 
-    return jax.tree_util.tree_map_with_path(reshard, opt_state)
+
+def shard_opt_state(plan: ZeroPlan, opt_state, params: Dict[str, Any]):
+    """Shard optimizer-state leaves of eligible params over the axis —
+    the ZeRO-1 move (arXiv:2004.13336), shared by stages 1-3 (matching
+    rule: :func:`opt_state_shardings`)."""
+    shardings = opt_state_shardings(plan, opt_state, params)
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    placed = [leaf if sh is None else jax.device_put(leaf, sh)
+              for leaf, sh in zip(leaves, shardings)]
+    return jax.tree_util.tree_unflatten(treedef, placed)
 
 
 # ---------------------------------------------------------------------------

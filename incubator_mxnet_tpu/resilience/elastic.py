@@ -9,13 +9,21 @@ fewer chips). The missing layer is rebuild-and-reshard:
 2. the caller-supplied ``build_fn`` constructs a **fresh trainer and
    feed on the surviving mesh** (a smaller device set, a different
    process count — whatever is actually alive);
-3. ``CheckpointManager.restore_latest`` restores the newest valid
-   checkpoint into it — ``parallel.restore_sharded`` detects the
-   topology change and engages the slice-planning reshard engine
+3. **the surviving state migrates in** (ISSUE 15): when the dead
+   incarnation's device arrays still cover the new topology, they
+   reshard device-to-device through ``parallel.migrate`` — zero host
+   bytes, no checkpoint round-trip — and the run resumes at the exact
+   failure step (RNG + feed position carried from the supervisor's
+   step-boundary snapshot). Only when migration is impossible (buffers
+   died with their chips, the optimizer structure changed, the feed is
+   not resumable, ``MXTPU_ELASTIC_MIGRATE=0``) does
+   ``CheckpointManager.restore_latest`` restore the newest valid
+   checkpoint — ``parallel.restore_sharded`` detects the topology
+   change and engages the slice-planning reshard engine
    (``parallel/reshard.py``), and the data sidecars re-partition the
    global sample position over the new rank count
    (``data.state.restore_sidecars``);
-4. the supervised loop continues from the restored step.
+4. the supervised loop continues from the resumed step.
 
 Because every rewound ingredient stays bit-exact (tensors restore
 bit-identically under resharding; the input stream is re-dealt from the
@@ -87,6 +95,7 @@ class ElasticRunner:
     def __init__(self, build_fn: Callable[[int], Tuple[Any, Any]],
                  root: str, *, max_incarnations: Optional[int] = None,
                  manager_kwargs: Optional[Dict[str, Any]] = None,
+                 migrate: Optional[bool] = None,
                  **supervisor_kwargs):
         self.build_fn = build_fn
         self.root = root
@@ -95,6 +104,15 @@ class ElasticRunner:
             if max_incarnations is None else max_incarnations)
         self.manager_kwargs = dict(manager_kwargs or {})
         self.supervisor_kwargs = dict(supervisor_kwargs)
+        # ISSUE 15: when the surviving in-memory state covers the new
+        # topology, a rebuild migrates it device-to-device
+        # (parallel.migrate) and resumes at the exact failure step —
+        # no checkpoint round-trip. The checkpoint path stays as the
+        # fallback (dead buffers, structure change, non-resumable
+        # feed). MXTPU_ELASTIC_MIGRATE=0 forces the old behavior.
+        self.migrate_enabled = bool(_cfg("MXTPU_ELASTIC_MIGRATE")
+                                    if migrate is None else migrate)
+        self.migrated_rebuilds = 0
         self.incarnation = 0
         self.supervisor: Optional[Supervisor] = None
         self.manager: Optional[CheckpointManager] = None
@@ -103,6 +121,10 @@ class ElasticRunner:
         self._t_incarnations = telemetry.counter(
             "mxtpu_resilience_incarnations_total",
             "elastic trainer rebuilds after a fatal incarnation loss")
+        self._t_migrated = telemetry.counter(
+            "mxtpu_resilience_migrated_rebuilds_total",
+            "elastic rebuilds resumed by in-ICI state migration "
+            "instead of a checkpoint restore")
 
     def run(self, steps: int) -> List[float]:
         """Supervised steps ``0..steps`` across as many incarnations as
@@ -112,21 +134,35 @@ class ElasticRunner:
         (bit-exact) values."""
         merged: Dict[int, float] = {}
         incarnation = self.incarnation
+        carry: Optional[Dict[str, Any]] = None
         while True:
             trainer, feed = self.build_fn(incarnation)
             self.manager = CheckpointManager(self.root,
                                              **self.manager_kwargs)
-            self.supervisor = Supervisor(trainer, self.manager,
-                                         **self.supervisor_kwargs)
+            self.supervisor = Supervisor(
+                trainer, self.manager,
+                capture_entry_state=self.migrate_enabled,
+                **self.supervisor_kwargs)
             self.incarnation = incarnation
+            start_step = None
+            if carry is not None:
+                # the ISSUE 15 short-circuit: surviving device state
+                # migrates onto the new topology and the run resumes at
+                # the exact failure step — the checkpoint restore (the
+                # old always-re-restore path) only runs when migration
+                # is not possible
+                start_step = self._migrate_in(carry, trainer, feed)
+                carry = None
             try:
-                out = self.supervisor.run(feed, steps=steps)
+                out = self.supervisor.run(feed, steps=steps,
+                                          start_step=start_step)
             except (KeyboardInterrupt, Preempted):
                 raise
             except BaseException as exc:    # noqa: BLE001 — policy layer
                 # keep what this incarnation proved before dying, then
                 # rebuild on whatever the next build_fn says is alive
                 merged.update(self.supervisor.losses)
+                carry = self._capture_carry(trainer)
                 self._close(feed)
                 try:
                     # settle in-flight async saves: two managers' writer
@@ -162,6 +198,84 @@ class ElasticRunner:
                         "rebuilds": incarnation})
             return [float(merged.get(i, float("nan")))
                     for i in range(int(steps))]
+
+    # -- the in-memory rebuild path (ISSUE 15) -------------------------------
+    def _capture_carry(self, trainer) -> Optional[Dict[str, Any]]:
+        """What survives an incarnation loss: the dead trainer's device
+        arrays plus the supervisor's step-boundary snapshot (step, RNG,
+        feed position). ``None`` when migration is disabled or no step
+        boundary was ever reached."""
+        if not self.migrate_enabled or self.supervisor is None:
+            return None
+        entry = self.supervisor.entry_state
+        if entry is None:
+            return None
+        return {"trainer": trainer, "entry": entry}
+
+    def _migrate_in(self, carry: Dict[str, Any], trainer, feed
+                    ) -> Optional[int]:
+        """Try to resume the new incarnation from the carried in-memory
+        state: migrate the dead trainer's arrays onto the new layouts
+        (``parallel.migrate`` — in-ICI, zero host bytes), rewind the
+        feed to the failure step's batch, restore the RNG stream.
+        Returns the resume step, or ``None`` to fall back to the
+        checkpoint restore."""
+        import copy
+
+        from .. import random as _random
+        from ..parallel import migrate as migrate_mod
+
+        old, entry = carry["trainer"], carry["entry"]
+        try:
+            if old is not trainer:
+                migrate_mod.migrate_trainer_state(old, trainer,
+                                                  site="elastic")
+            feed_state = entry.get("feed_state")
+            if feed_state is None and entry.get("feed_resumable"):
+                # the dead feed WAS resumable but its position snapshot
+                # failed — resuming with a from-the-top stream would
+                # silently misalign steps and batches
+                raise migrate_mod.MigrateError(
+                    "the failed feed was resumable but its position "
+                    "snapshot is missing")
+            if feed_state is not None:
+                if not hasattr(feed, "load_state_dict"):
+                    raise migrate_mod.MigrateError(
+                        "new feed is not resumable but the failed one "
+                        "was — its position cannot carry")
+                try:
+                    feed.load_state_dict(copy.deepcopy(feed_state))
+                except Exception:
+                    # a topology-changed feed re-deals the global
+                    # sample position the sidecar way
+                    from ..data.state import reshard_iterator_state
+
+                    reshard_iterator_state([feed_state], feed)
+            _random.set_state(entry["rng"])
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:        # noqa: BLE001 — fall back
+            _log.warning(
+                "in-memory elastic migration not possible (%s: %s); "
+                "falling back to the checkpoint restore",
+                type(exc).__name__, exc)
+            self._emit({"event": "elastic_migrate_fallback",
+                        "incarnation": self.incarnation,
+                        "error": str(exc)[:200]})
+            return None
+        self.migrated_rebuilds += 1
+        self._t_migrated.inc()
+        stats = migrate_mod.last_stats() if old is not trainer else None
+        self._emit({"event": "elastic_migrate",
+                    "incarnation": self.incarnation,
+                    "step": int(entry["step"]),
+                    "wire_bytes": int(stats["wire_bytes"])
+                    if stats else 0})
+        _log.info(
+            "incarnation %d resumes at step %d from migrated in-memory "
+            "state (no checkpoint round-trip)", self.incarnation,
+            entry["step"])
+        return int(entry["step"])
 
     @staticmethod
     def _close(feed) -> None:

@@ -133,6 +133,7 @@ class Supervisor:
                  seed: int = 0,
                  sleep: Callable[[float], None] = time.sleep,
                  steps_per_call: Optional[int] = None,
+                 capture_entry_state: bool = False,
                  site: str = "supervisor"):
         self.trainer = trainer
         self.manager = manager
@@ -162,6 +163,13 @@ class Supervisor:
         # read the trainer's nominal window (superstep_window attr,
         # set by SPMDTrainer.superstep_feed), default 1.
         self.steps_per_call = steps_per_call
+        # ISSUE 15: snapshot (step, RNG, feed position) at every step
+        # boundary so an elastic rebuild can resume IN MEMORY (no
+        # checkpoint round-trip) from the exact failure step — see
+        # resilience.elastic. Off by default: the snapshot costs one
+        # state_dict per step.
+        self.capture_entry_state = bool(capture_entry_state)
+        self.entry_state: Optional[Dict[str, Any]] = None
         self.site = site
         self._sleep = sleep
         self._rng = _pyrandom.Random(seed)   # backoff jitter only
@@ -245,6 +253,10 @@ class Supervisor:
                 self._checkpoint(feed, sync=True)
                 self._emit({"event": "preempted", "step": self.step_num})
                 raise Preempted(self.step_num)
+            if self.capture_entry_state:
+                # BEFORE the batch is pulled and before any RNG draw,
+                # so an in-memory resume replays the failed step exactly
+                self.entry_state = self._entry_snapshot(feed)
             try:
                 batch, feed_iter = self._next_batch(feed, feed_iter)
                 loss = self._attempt(batch)
@@ -308,6 +320,28 @@ class Supervisor:
             return max(1, int(self.steps_per_call))
         return max(1, int(getattr(self.trainer, "superstep_window", 1)
                           or 1))
+
+    def _entry_snapshot(self, feed) -> Dict[str, Any]:
+        """State at a step boundary — what an in-memory elastic rebuild
+        (``resilience.elastic`` + ``parallel.migrate``) needs to resume
+        WITHOUT a checkpoint: the step number, the global RNG state,
+        and the resumable feed's position."""
+        from .. import random as _random
+
+        state = None
+        f = self._resumable(feed)
+        if f is not None:
+            try:
+                state = f.state_dict()
+            except Exception:           # a wedged feed falls back to
+                state = None            # the checkpoint path
+        # feed_resumable distinguishes "plain feed, nothing to carry"
+        # (in-memory resume is as good as the checkpoint path) from
+        # "resumable feed whose snapshot FAILED" (the rebuild must not
+        # resume with a from-the-top stream — checkpoint fallback)
+        return {"step": int(self.step_num),
+                "rng": _random.get_state(), "feed_state": state,
+                "feed_resumable": f is not None}
 
     @staticmethod
     def _resumable(feed):
@@ -473,6 +507,11 @@ class Supervisor:
             _log.error("restart budget exhausted (%d); giving up",
                        self.max_restarts)
             raise exc
+        # the restore below mutates trainer/feed/RNG; if it dies
+        # half-way the step-boundary snapshot no longer describes the
+        # live state — an elastic rebuild must not migrate the mix
+        # (a fresh snapshot is taken at the next step boundary)
+        self.entry_state = None
         try:
             self.manager.wait()            # settle in-flight saves first
         except Exception as save_err:

@@ -1,0 +1,81 @@
+"""Worker payload for the in-ICI migrate contract (ISSUE 15): on a
+2-process mesh, a device→device layout flip must hand every process
+exactly its DESTINATION ranges — each local device receives only the
+bytes of its destination shard box that no local source shard already
+covers, the plan accounts them per device, and the migrated local
+shards are bit-identical to the oracle's destination slices.
+
+Launched by ``tools/launch.py`` (2 workers) — the slow-marked
+``tests/test_distributed.py`` case; the TPU-tier driver runs it
+alongside the other ``dist_*`` payloads (and the pending BENCH_r06
+cut), where the exchange really crosses ICI.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import collectives
+    from incubator_mxnet_tpu.parallel import migrate
+
+    collectives.init_distributed()
+    rank = jax.process_index()
+    size = jax.process_count()
+    assert size >= 2, size
+
+    devs = jax.devices()                      # one device per process
+    mesh = Mesh(np.array(devs), ("data",))
+    R, C = 8 * size, 4
+    full = np.arange(R * C, dtype=np.float32).reshape(R, C)
+    src_sh = NamedSharding(mesh, P("data"))          # row shards
+    dst_sh = NamedSharding(mesh, P(None, "data"))    # column shards
+    x = jax.make_array_from_callback(
+        (R, C), src_sh, lambda idx: full[idx])
+
+    plan = migrate.plan_arrays({"w": x}, {"w": dst_sh})
+    out = migrate.migrate_arrays({"w": x}, {"w": dst_sh})
+    stats = migrate.last_stats()
+    assert stats["peak_host_bytes"] == 0
+
+    # 1) this process's devices hold exactly their destination ranges
+    for shard in out["w"].addressable_shards:
+        idx = shard.index
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      full[idx])
+
+    # 2) each local device received ONLY its destination range minus
+    #    what its own source shard already covered: the dest column
+    #    block is R x (C/size); the local source rows cover
+    #    (R/size) x (C/size) of it — the rest came over the wire
+    per_cols = C // size
+    expect_recv = (R - R // size) * per_cols * 4
+    recv = stats["recv_bytes_by_device"]
+    for d in jax.local_devices():
+        assert recv.get(d.id, 0) == expect_recv, (
+            rank, d.id, recv, expect_recv)
+    # and nothing beyond the destination ranges moved anywhere
+    assert stats["wire_bytes"] == expect_recv * size
+    assert plan["wire_bytes"] == stats["wire_bytes"]
+    assert stats["tensors"]["w"]["ops"] == size * size
+
+    print(f"RANK {rank}/{size} MIGRATE OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

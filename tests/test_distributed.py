@@ -111,6 +111,26 @@ def test_comm_compute_overlap_measurement_2procs():
     print("overlap:", r)
 
 
+@pytest.mark.slow
+def test_launcher_runs_migrate_payload_2procs():
+    """ISSUE 15: the in-ICI migrate payload on a 2-process mesh — each
+    process receives ONLY its destination ranges (plan-accounted per
+    device, migrated shards bit-identical to the oracle's destination
+    slices, peak host bytes 0). Slow tier: the TPU driver runs it
+    alongside the other dist_* payloads, where the exchange really
+    crosses ICI; this container's CPU backend has no multiprocess
+    collectives, matching the other launcher tests."""
+    payload = os.path.join(REPO, "tests", "dist_migrate_payload.py")
+    proc = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", "2", "--launcher", "local",
+         sys.executable, payload],
+        env=_clean_env(), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}")
+    for rank in range(2):
+        assert f"RANK {rank}/2 MIGRATE OK" in proc.stdout
+
+
 def test_launcher_accepts_reference_cli_shape():
     """-s servers accepted (ignored with a note), matching reference CLI."""
     proc = subprocess.run(

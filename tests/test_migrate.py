@@ -1,0 +1,654 @@
+"""In-ICI device→device live resharding (ISSUE 15,
+docs/SCALING.md "Live resharding").
+
+The contracts pinned here: the device path is BIT-IDENTICAL to the
+PR 7 host-path restore across the {1, 2, 4, 2×2}² src×dst layout
+matrix (ragged/partial-overlap boxes and ZeRO-3 param states
+included) with zero host-gather bytes; wire bytes match the planned
+schedule's accounting; repeated identical flips trigger ZERO
+recompiles under the armed watchdog; the ZeRO-3→serving flip feeds a
+warm ``ModelServer``/``DecodeSession`` with zero post-warmup
+compiles; and an ``ElasticRunner`` rebuild short-circuits through
+migrate (exact-failure-step resume) with the checkpoint path as
+fallback."""
+
+import os
+
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, serving, telemetry
+from incubator_mxnet_tpu import data as mxdata
+from incubator_mxnet_tpu.config import config
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import migrate as migrate_mod
+from incubator_mxnet_tpu.parallel.migrate import MigrateError
+
+import jax
+
+
+MESH_SHAPES = {
+    "1": {"data": 1},
+    "2": {"data": 2},
+    "4": {"data": 4},
+    "2x2": {"data": 2, "model": 2},
+}
+
+
+def _mesh(key):
+    axes = MESH_SHAPES[key]
+    n = int(np.prod(list(axes.values())))
+    return parallel.make_mesh(dict(axes), devices=jax.devices()[:n])
+
+
+def _trainer(mesh, seed=0, zero=False, zero_stage=None):
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    # 0.bias (16,) is ragged on the 4-dev data axis; Dense(6) keeps a
+    # dim that never divides 4 — partial-overlap/replicated-fallback
+    # boxes ride every matrix cell
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(6, in_units=16, activation="relu"),
+            nn.Dense(4, in_units=6))
+    net.initialize(init="xavier")
+    if "model" in mesh.axis_names:
+        parallel.shard_params(net, {
+            r"0\.weight": P("model", None),
+            r"3\.weight": P(None, "model"),
+        })
+    kwargs = {}
+    if zero_stage is not None:
+        kwargs["zero_stage"] = zero_stage
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+        donate=False, shard_weight_update=zero, **kwargs)
+    return net, tr
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(16, 8).astype(np.float32),
+            rng.randint(0, 4, (16,)).astype(np.float32))
+
+
+def _assert_state_equal(a, b):
+    for n in a.params:
+        np.testing.assert_array_equal(np.asarray(a.params[n]),
+                                      np.asarray(b.params[n]), n)
+    for n in a.frozen:
+        np.testing.assert_array_equal(np.asarray(a.frozen[n]),
+                                      np.asarray(b.frozen[n]), n)
+    al = jax.tree_util.tree_leaves(a.opt_state)
+    bl = jax.tree_util.tree_leaves(b.opt_state)
+    for x, y in zip(al, bl):
+        if hasattr(x, "shape"):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """One stepped + checkpointed source trainer per layout (the host
+    oracle restores from the checkpoint; the device path migrates the
+    LIVE trainer)."""
+    root = tmp_path_factory.mktemp("migrate")
+    out = {}
+    x, y = _batch(0)
+    for key in MESH_SHAPES:
+        net, tr = _trainer(_mesh(key), seed=int(key[0]))
+        tr.step(x, y)                     # momentum + BN stats nonzero
+        prefix = str(root / f"ckpt-{key}" / "ckpt")
+        os.makedirs(os.path.dirname(prefix))
+        parallel.save_sharded(prefix, tr)
+        out[key] = (prefix, tr, net)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the core contract: device path == host path, bit for bit
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("src_key", list(MESH_SHAPES))
+@pytest.mark.parametrize("dst_key", list(MESH_SHAPES))
+def test_migrate_matrix_bit_identical(saved, src_key, dst_key):
+    """Every src×dst layout flip: the in-ICI migration hands the
+    destination trainer the SOURCE state bit-for-bit — params, BN
+    stats, optimizer leaves — with ZERO host bytes on the device path.
+    (Value-equality against the source IS host-path equality: the PR 7
+    matrix proves the checkpoint restore bit-identical to the source
+    state; test_migrate_matches_host_oracle_restore additionally runs
+    the literal restore side by side on representative cells.)"""
+    _prefix, src, _ = saved[src_key]
+    _, via_dev = _trainer(_mesh(dst_key), seed=78)
+    migrate_mod.migrate_trainer_state(src, via_dev)
+    _assert_state_equal(src, via_dev)
+    stats = migrate_mod.last_stats()
+    assert stats["peak_host_bytes"] == 0
+    assert stats["tensors_total"] == stats["moved"] + stats["aliased"]
+
+
+@pytest.mark.parametrize("src_key,dst_key",
+                         [("4", "2x2"), ("2x2", "2"), ("1", "4")])
+def test_migrate_matches_host_oracle_restore(saved, src_key, dst_key):
+    """The literal host-path oracle: a checkpoint restore through the
+    PR 7 planner and the device migration land the SAME destination
+    state, bit for bit."""
+    prefix, src, _ = saved[src_key]
+    _, via_host = _trainer(_mesh(dst_key), seed=77)
+    parallel.restore_sharded(prefix, via_host, reshard="always")
+    _, via_dev = _trainer(_mesh(dst_key), seed=78)
+    migrate_mod.migrate_trainer_state(src, via_dev)
+    _assert_state_equal(via_host, via_dev)
+
+
+def test_zero3_param_state_migrates_to_serving_layout(saved):
+    """ZeRO-3 params (sharded 1/N at rest) flip onto a stage-0 2×2
+    trainer: values equal the host-oracle restore, and each tensor
+    lands committed with the DESTINATION trainer's sharding."""
+    x, y = _batch(0)
+    _, src = _trainer(_mesh("4"), seed=11, zero_stage=3)
+    src.step(x, y)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as root:
+        prefix = os.path.join(root, "ckpt")
+        parallel.save_sharded(prefix, src)
+        _, via_host = _trainer(_mesh("2x2"), seed=12)
+        parallel.restore_sharded(prefix, via_host, reshard="always")
+        _, via_dev = _trainer(_mesh("2x2"), seed=13)
+        migrate_mod.migrate_trainer_state(src, via_dev)
+        _assert_state_equal(via_host, via_dev)
+    # every tensor came back committed on the DESTINATION mesh (no
+    # leaf kept the source mesh's sharding object)
+    for n in via_dev.params:
+        assert via_dev.params[n].sharding.mesh == via_dev.mesh, n
+
+
+# ---------------------------------------------------------------------------
+# plan accounting
+# ---------------------------------------------------------------------------
+def test_plan_accounting_hand_case():
+    """1-device replicated -> 2-way sharded: device 0 keeps its half
+    locally, device 1 receives its destination rows — 16 bytes on the
+    wire, 2 slice ops, accounted per receiving device."""
+    m1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    m2 = parallel.make_mesh({"data": 2}, devices=jax.devices()[:2])
+    y = jax.device_put(np.arange(8, dtype=np.float32).reshape(4, 2),
+                       NamedSharding(m1, P()))
+    plan = migrate_mod.plan_arrays({"y": y},
+                                   {"y": NamedSharding(m2, P("data"))})
+    assert plan["plan_ops"] == 2
+    assert plan["wire_bytes"] == 16          # 2 rows x 2 cols x 4 B
+    dev1 = jax.devices()[1].id
+    assert plan["recv_bytes_by_device"] == {dev1: 16}
+    assert plan["fp_wire_bytes"] == 16 and plan["quant_fraction"] == 1.0
+
+
+def test_executed_stats_match_plan(saved):
+    """migrate_arrays executes exactly the plan it accounts: the
+    stats of a run equal plan_arrays' numbers."""
+    _prefix, src, _ = saved["2"]
+    _, dst = _trainer(_mesh("4"), seed=21)
+    tree = dict(src.params)
+    dest = {n: dst.params[n].sharding for n in tree}
+    plan = migrate_mod.plan_arrays(tree, dest)
+    migrate_mod.migrate_arrays(tree, dest)
+    stats = migrate_mod.last_stats()
+    for key in ("plan_ops", "wire_bytes", "fp_wire_bytes", "moved",
+                "aliased"):
+        assert stats[key] == plan[key], key
+    assert stats["mode"] in ("executable", "device_put", "mixed")
+
+
+def test_identical_layout_is_a_zero_work_alias(saved):
+    """src sharding == dst sharding for every leaf: no executable, no
+    wire, the very same array objects hand back."""
+    _prefix, src, _ = saved["2"]
+    tree = dict(src.params)
+    out = migrate_mod.migrate_arrays(
+        tree, {n: a.sharding for n, a in tree.items()})
+    stats = migrate_mod.last_stats()
+    assert stats["mode"] == "alias"
+    assert stats["moved"] == 0 and stats["wire_bytes"] == 0
+    assert all(out[n] is tree[n] for n in tree)
+
+
+def test_migrate_refuses_host_arrays_and_bad_structure():
+    m2 = parallel.make_mesh({"data": 2}, devices=jax.devices()[:2])
+    with pytest.raises(MigrateError, match="not a device array"):
+        migrate_mod.plan_arrays({"x": np.zeros((4, 2), np.float32)},
+                                {"x": NamedSharding(m2, P("data"))})
+    x = jax.device_put(np.zeros((4, 2), np.float32),
+                       NamedSharding(m2, P("data")))
+    with pytest.raises(MigrateError, match="structure"):
+        migrate_mod.migrate_arrays({"x": x}, {"y": x.sharding})
+
+
+# ---------------------------------------------------------------------------
+# the recompile contract
+# ---------------------------------------------------------------------------
+def test_repeated_flip_zero_recompiles_under_watchdog():
+    """The executable caches per (src-layout, dst-layout, topology):
+    flipping FRESH arrays through a known layout pair performs zero
+    XLA compiles under the armed watchdog."""
+    wd = telemetry.get_watchdog()
+    assert wd is not None
+    mA = parallel.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    mB = parallel.make_mesh({"data": 2, "model": 2},
+                            devices=jax.devices()[:4])
+    dst = NamedSharding(mB, P("data", "model"))
+
+    def flip(seed):
+        x = jax.device_put(
+            np.random.RandomState(seed).rand(8, 4).astype(np.float32),
+            NamedSharding(mA, P("data")))
+        return migrate_mod.migrate_arrays({"x": x}, {"x": dst},
+                                          site="flip-test")
+
+    flip(0)                                   # may compile (first flip)
+    before = wd.compile_count
+    out = flip(1)
+    assert wd.compile_count == before, \
+        "a repeated identical flip recompiled"
+    assert migrate_mod.last_stats()["compiled"] is False
+    assert out["x"].sharding.is_equivalent_to(dst, 2)
+
+
+# ---------------------------------------------------------------------------
+# quantized payloads (MXTPU_MIGRATE_QUANT)
+# ---------------------------------------------------------------------------
+def test_quantized_migration_error_bounded():
+    """int8 payloads: per-block error bounded by max|block|/254 (half a
+    quantization step); fp default stays bit-exact; wire accounting
+    reflects the 1-byte codes + replicated scales. The flip runs over
+    the SAME chips (mesh reshape) — the executable path, where the
+    in-graph quantize→exchange→dequantize lives."""
+    m4 = parallel.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    m22 = parallel.make_mesh({"data": 2, "model": 2},
+                             devices=jax.devices()[:4])
+    block = 8
+    x_np = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    x = jax.device_put(x_np, NamedSharding(m4, P("data")))
+    dst = {"x": NamedSharding(m22, P("model", "data"))}
+
+    exact = migrate_mod.migrate_arrays({"x": x}, dst)   # default: none
+    np.testing.assert_array_equal(np.asarray(exact["x"]), x_np)
+    assert migrate_mod.last_stats()["quant_fraction"] == 1.0
+
+    q = migrate_mod.migrate_arrays({"x": x}, dst, quant="int8",
+                                   block=block)
+    err = np.abs(np.asarray(q["x"]) - x_np).reshape(-1, block)
+    bound = np.abs(x_np).reshape(-1, block).max(axis=1) / 254.0 + 1e-7
+    assert (err.max(axis=1) <= bound).all()
+    assert (err > 0).any(), "quantization did not engage"
+    stats = migrate_mod.last_stats()
+    assert stats["quant"] == "int8"
+    assert 0 < stats["wire_bytes"] < stats["fp_wire_bytes"]
+    assert stats["quant_fraction"] < 1.0
+
+
+def test_quant_ineligible_tensors_stay_exact():
+    """Non-float and non-block-divisible tensors migrate exactly even
+    with the knob on; so does everything when nothing moves."""
+    m4 = parallel.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    m22 = parallel.make_mesh({"data": 2, "model": 2},
+                             devices=jax.devices()[:4])
+    ints = jax.device_put(np.arange(32, dtype=np.int32).reshape(8, 4),
+                          NamedSharding(m4, P("data")))
+    odd = jax.device_put(np.random.RandomState(1).rand(6).astype(
+        np.float32), NamedSharding(m4, P()))
+    config.set("MXTPU_MIGRATE_QUANT", "int8")
+    try:
+        out = migrate_mod.migrate_arrays(
+            {"i": ints, "o": odd},
+            {"i": NamedSharding(m22, P("model", "data")),
+             "o": NamedSharding(m22, P())}, block=256)
+    finally:
+        config.unset("MXTPU_MIGRATE_QUANT")
+    np.testing.assert_array_equal(np.asarray(out["i"]),
+                                  np.asarray(ints))
+    np.testing.assert_array_equal(np.asarray(out["o"]),
+                                  np.asarray(odd))
+    tensors = migrate_mod.last_stats()["tensors"]
+    assert not any(t["quantized"] for t in tensors.values())
+
+
+# ---------------------------------------------------------------------------
+# consumers: ZeRO placement, serving, decode
+# ---------------------------------------------------------------------------
+def test_apply_zero_placement_routes_through_migrate(saved, tmp_path):
+    """A stage-0 checkpoint restored (legacy gather) onto a ZeRO-3
+    trainer: the post-restore re-placement runs as ONE migrate call at
+    site zero.placement and the params land sharded 1/N."""
+    x, y = _batch(0)
+    _, src = _trainer(_mesh("4"), seed=31)
+    src.step(x, y)
+    prefix = str(tmp_path / "ckpt")
+    parallel.save_sharded(prefix, src)
+    _, dst = _trainer(_mesh("4"), seed=32, zero_stage=3)
+    before = migrate_mod.last_stats()
+    parallel.restore_sharded(prefix, dst, reshard="never")
+    stats = migrate_mod.last_stats()
+    assert stats is not before and stats["site"] == "zero.placement"
+    assert stats["peak_host_bytes"] == 0
+    _assert_state_equal(src, dst)
+    for n in dst.zero_plan.eligible:
+        spec = dst.params[n].sharding.spec
+        assert tuple(spec)[:1] == ("data",), (n, spec)
+
+
+def test_zero3_to_model_server_flip_zero_postwarmup_compiles():
+    """The serving consumer: a trained ZeRO-3 layout flips replicated
+    in ICI (serving_weights) and publishes into a WARM ModelServer —
+    zero post-warmup compiles under the armed watchdog, outputs equal
+    the trained net's eager forward."""
+    np.random.seed(41)
+    mx.random.seed(41)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(8, in_units=16))
+    net.initialize(init="xavier")
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=parallel.make_mesh({"data": -1}),
+        donate=False, zero_stage=3)
+    x = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.randint(0, 8, (16,)).astype(np.float32)
+    for _ in range(2):
+        tr.step(x, y)
+    weights = migrate_mod.serving_weights(tr)
+    stats = migrate_mod.last_stats()
+    assert stats["site"] == "serving" and stats["peak_host_bytes"] == 0
+    assert stats["moved"] > 0                # ZeRO-3 shards really flip
+    for arr in weights.values():
+        assert arr.sharding.is_equivalent_to(
+            NamedSharding(tr.mesh, P()), arr.ndim)
+
+    tr.sync_to_net()
+    q = np.random.rand(8).astype(np.float32)
+    want = net(mx.nd.array(q.reshape(1, -1))).asnumpy()[0]
+
+    np.random.seed(99)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, in_units=8, activation="relu"),
+             nn.Dense(8, in_units=16))
+    net2.initialize(init="xavier")
+    with serving.ModelServer(net2, max_wait_ms=1.0,
+                             buckets=(1, 2)) as srv:
+        srv.warmup((8,), "float32")
+        wd = telemetry.get_watchdog()
+        before = wd.compile_count
+        srv.publish_weights(weights)
+        got = np.asarray(srv.predict(q, timeout=60.0))
+        assert wd.compile_count == before, \
+            "the weight flip triggered a post-warmup compile"
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_train_zero_migrate_decode_parity():
+    """train(ZeRO) → migrate → DecodeSession: the flipped weights
+    publish into a warm decode session and the greedy stream equals
+    the trained net's full-forward oracle."""
+    from incubator_mxnet_tpu.gluon.model_zoo import get_gpt
+
+    VOCAB = 61
+    np.random.seed(5)
+    mx.random.seed(5)
+    net = get_gpt("gpt_decoder_tiny", vocab_size=VOCAB, units=16,
+                  num_layers=1, max_length=24, dropout=0.0)
+    net.initialize(init="xavier")
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return ce(logits, labels).mean()
+
+    trainer = parallel.SPMDTrainer(
+        net, lm_loss, "sgd", {"learning_rate": 0.05, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": -1}), donate=False,
+        zero_stage=2)
+    B, T = len(jax.devices()), 10
+    rs = np.random.RandomState(100)
+    trainer.step(rs.randint(1, VOCAB, (B, T)).astype(np.int32),
+                 rs.randint(1, VOCAB, (B, T)).astype(np.float32))
+
+    weights = migrate_mod.serving_weights(trainer)
+    trainer.sync_to_net()
+    prompt = np.random.RandomState(6).randint(
+        1, VOCAB, (7,)).astype(np.int32)
+
+    # oracle on the trained net: greedy via the full causal forward
+    seq, want = list(int(t) for t in prompt), []
+    for _ in range(6):
+        lg = net(mx.nd.array(np.array(seq)[None],
+                             dtype="int32")).asnumpy()
+        tok = int(np.argmax(lg[0, -1]))
+        want.append(tok)
+        seq.append(tok)
+
+    np.random.seed(777)
+    mx.random.seed(777)
+    net2 = get_gpt("gpt_decoder_tiny", vocab_size=VOCAB, units=16,
+                   num_layers=1, max_length=24, dropout=0.0)
+    net2.initialize(init="xavier")        # different init, overwritten
+    sess = serving.DecodeSession(net2, max_slots=2, max_len=24,
+                                 prefill_buckets=(8,), name="mig-e2e")
+    try:
+        sess.warmup()
+        wd = telemetry.get_watchdog()
+        before = wd.compile_count
+        sess.publish_weights(weights)
+        got = sess.generate(prompt, max_new_tokens=6)
+        assert wd.compile_count == before, \
+            "the weight flip triggered a post-warmup compile"
+    finally:
+        sess.close()
+    assert got == want, "decode from migrated weights diverged"
+
+
+# ---------------------------------------------------------------------------
+# elastic short-circuit (satellite: no more always-re-restore)
+# ---------------------------------------------------------------------------
+def _elastic_build(_incarnation=0):
+    mx.random.seed(21)
+    np.random.seed(21)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1},
+        mesh=parallel.make_mesh({"data": 1},
+                                devices=jax.devices()[:1]))
+    rs = np.random.RandomState(2)
+    pipe = (mxdata.from_ndarray(rs.rand(96, 8).astype(np.float32),
+                                rs.randint(0, 4, (96,)).astype(
+                                    np.float32))
+            .shuffle(16, seed=3).batch(8).shard(0, 1))
+    return tr, pipe
+
+
+def _elastic_reference(steps=12):
+    tr, pipe = _elastic_build()
+    ref, it = [], iter(pipe)
+    for _ in range(steps):
+        try:
+            b = next(it)
+        except StopIteration:
+            it = iter(pipe)
+            b = next(it)
+        ref.append(float(tr.step(*b)))
+    pipe.close()
+    return ref
+
+
+def test_elastic_rebuild_short_circuits_through_migrate(tmp_path):
+    """A fatal loss at step 6 with checkpoints every 4: the rebuild
+    migrates the surviving state and resumes AT STEP 6 — not at the
+    step-4 checkpoint — and the merged loss stream still equals the
+    uninterrupted run bit-exactly."""
+    from incubator_mxnet_tpu import resilience
+
+    ref = _elastic_reference()
+    runner = resilience.ElasticRunner(
+        _elastic_build, str(tmp_path / "root"), max_incarnations=2,
+        checkpoint_every=4, backoff_base_s=0.01, max_restarts=0)
+    assert runner.migrate_enabled             # MXTPU_ELASTIC_MIGRATE=1
+    resilience.chaos.configure(
+        {"step": {"fatal_calls": [7], "transient": False}}, seed=0)
+    try:
+        losses = runner.run(12)
+    finally:
+        resilience.chaos.disable()
+    assert losses == ref
+    assert runner.incarnation == 1
+    assert runner.migrated_rebuilds == 1
+    # the short-circuit: incarnation 1 started at the FAILURE step,
+    # nothing re-ran from the checkpoint
+    assert min(runner.supervisor.losses) == 6
+
+
+def test_elastic_falls_back_to_checkpoint_on_migrate_refusal(
+        tmp_path, monkeypatch):
+    """When migration is impossible the checkpoint path restores as
+    before (the pre-ISSUE-15 behavior is the fallback, not gone)."""
+    from incubator_mxnet_tpu import resilience
+
+    ref = _elastic_reference()
+
+    def refuse(*_a, **_k):
+        raise MigrateError("buffers died with their chips")
+
+    monkeypatch.setattr(migrate_mod, "migrate_trainer_state", refuse)
+    runner = resilience.ElasticRunner(
+        _elastic_build, str(tmp_path / "root"), max_incarnations=2,
+        checkpoint_every=4, backoff_base_s=0.01, max_restarts=0)
+    resilience.chaos.configure(
+        {"step": {"fatal_calls": [7], "transient": False}}, seed=0)
+    try:
+        losses = runner.run(12)
+    finally:
+        resilience.chaos.disable()
+    assert losses == ref
+    assert runner.migrated_rebuilds == 0
+    # checkpoint resume: incarnation 1 re-ran from the step-4 restore
+    assert min(runner.supervisor.losses) == 4
+
+
+def test_elastic_migrate_refuses_missing_feed_snapshot(tmp_path):
+    """A RESUMABLE feed whose position snapshot failed must not resume
+    in memory (the stream would restart from the top, silently
+    misaligned) — the rebuild falls back to the checkpoint path."""
+    from incubator_mxnet_tpu import random as mxrandom
+    from incubator_mxnet_tpu import resilience
+
+    runner = resilience.ElasticRunner(
+        _elastic_build, str(tmp_path / "root"))
+    tr, feed = _elastic_build()
+    try:
+        carry = {"trainer": tr,
+                 "entry": {"step": 5, "rng": mxrandom.get_state(),
+                           "feed_state": None, "feed_resumable": True}}
+        assert runner._migrate_in(carry, tr, feed) is None
+        # a plain (never-resumable) feed carries nothing and is fine
+        carry["entry"]["feed_resumable"] = False
+        assert runner._migrate_in(carry, tr, feed) == 5
+    finally:
+        feed.close()
+
+
+def test_zero_placement_stays_exact_with_quant_knob_on(tmp_path):
+    """MXTPU_MIGRATE_QUANT compresses elastic/serving flips; the
+    restore-time ZeRO re-placement pins quant=none — 'values are never
+    changed' holds even with the knob set."""
+    x, y = _batch(0)
+    _, src = _trainer(_mesh("4"), seed=51)
+    src.step(x, y)
+    prefix = str(tmp_path / "ckpt")
+    parallel.save_sharded(prefix, src)
+    _, dst = _trainer(_mesh("4"), seed=52, zero_stage=3)
+    config.set("MXTPU_MIGRATE_QUANT", "int8")
+    try:
+        parallel.restore_sharded(prefix, dst, reshard="never")
+    finally:
+        config.unset("MXTPU_MIGRATE_QUANT")
+    stats = migrate_mod.last_stats()
+    assert stats["site"] == "zero.placement"
+    assert stats["quant"] == "none"
+    _assert_state_equal(src, dst)
+
+
+def test_elastic_migrate_disabled_keeps_legacy_path(tmp_path):
+    from incubator_mxnet_tpu import resilience
+
+    ref = _elastic_reference()
+    runner = resilience.ElasticRunner(
+        _elastic_build, str(tmp_path / "root"), max_incarnations=2,
+        checkpoint_every=4, backoff_base_s=0.01, max_restarts=0,
+        migrate=False)
+    resilience.chaos.configure(
+        {"step": {"fatal_calls": [7], "transient": False}}, seed=0)
+    try:
+        losses = runner.run(12)
+    finally:
+        resilience.chaos.disable()
+    assert losses == ref and runner.migrated_rebuilds == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry / report / knob surface
+# ---------------------------------------------------------------------------
+def test_jsonl_record_report_section_and_compare_keys(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+
+    path = str(tmp_path / "run.jsonl")
+    telemetry.set_jsonl(path)
+    try:
+        m4 = parallel.make_mesh({"data": 4}, devices=jax.devices()[:4])
+        m22 = parallel.make_mesh({"data": 2, "model": 2},
+                                 devices=jax.devices()[:4])
+        x = jax.device_put(np.ones((8, 4), np.float32),
+                           NamedSharding(m4, P("data")))
+        migrate_mod.migrate_arrays(
+            {"x": x}, {"x": NamedSharding(m22, P("model", "data"))},
+            site="report-test")
+    finally:
+        telemetry.set_jsonl(None)
+    recs = telemetry.read_jsonl(path)
+    mig = [r for r in recs if r.get("kind") == "migrate"]
+    assert len(mig) == 1
+    r = mig[0]
+    assert r["site"] == "report-test" and r["peak_host_bytes"] == 0
+    assert r["wire_bytes"] > 0 and r["mode"] == "executable"
+    text = telemetry_report.summarize(path)
+    assert "migrate (live reshard)" in text and "report-test" in text
+    keys = telemetry_report._comparable_metrics(recs)
+    assert keys["migrate/report-test/migrations"] == 1.0
+    assert keys["migrate/report-test/wire_bytes"] == r["wire_bytes"]
+    assert keys["migrate/report-test/peak_host_bytes"] == 0.0
+
+
+def test_migrate_knobs_registered():
+    assert config.get("MXTPU_MIGRATE_QUANT") == "none"
+    assert config.get("MXTPU_ELASTIC_MIGRATE") is True
+    with pytest.raises(ValueError, match="not in"):
+        migrate_mod.resolve_quant("4bit")
+
+
+def test_reshard_bench_device_mode_smoke():
+    """benchmark/reshard_bench.py --device: device path asserts
+    peak_host_bytes == 0 internally and cross-checks bit-exactness
+    against the host path."""
+    import benchmark.reshard_bench as rb
+
+    rows = rb.compare_device(hidden=64)
+    assert rows["device_peak_host_bytes"] == 0
+    assert rows["device_mode"] == "executable"
+    assert rows["device_wire_bytes"] > 0
+    assert rows["host_bytes_read"] > 0

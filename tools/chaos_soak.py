@@ -264,11 +264,15 @@ def elastic_reference_run(steps: int, seed: int):
 
 
 def elastic_soak_run(steps: int, seed: int, ckpt_every: int, root: str,
-                     plan: dict, plan_seed: int, topo0, topo1):
+                     plan: dict, plan_seed: int, topo0, topo1,
+                     migrate: bool = False):
     """Incarnation 0 on topology ``topo0 = (sim_ranks, n_devices)``
     dies to a fatal fault (no in-place restarts: max_restarts=0); the
-    ElasticRunner rebuilds on ``topo1`` and the reshard-restore
-    continues the run (surviving a mid-restore fault on the way)."""
+    ElasticRunner rebuilds on ``topo1``. With ``migrate=False`` the
+    rebuild goes through the reshard-restore (surviving a mid-restore
+    fault on the way — the PR 7 contract this soak exists to prove);
+    ``migrate=True`` exercises the ISSUE 15 in-memory short-circuit
+    instead (no checkpoint round-trip at all)."""
     from incubator_mxnet_tpu import resilience
 
     def build_fn(incarnation):
@@ -277,7 +281,7 @@ def elastic_soak_run(steps: int, seed: int, ckpt_every: int, root: str,
 
     runner = resilience.ElasticRunner(
         build_fn, root, max_incarnations=4,
-        manager_kwargs={"keep_last_k": 3},
+        manager_kwargs={"keep_last_k": 3}, migrate=migrate,
         checkpoint_every=ckpt_every, backoff_base_s=0.01,
         max_restarts=0, seed=plan_seed)
     resilience.chaos.configure(plan, seed=plan_seed)
@@ -303,7 +307,16 @@ def elastic_main(args, plan: dict, root: str) -> int:
       tests prove that), but the loss stream is compared within float
       tolerance — partitioning the batch over a different device count
       changes XLA's reduction association order by design, so the last
-      ulp of a mean is not preserved across a mesh-size change.
+      ulp of a mean is not preserved across a mesh-size change;
+    * **migrate grow-back** (ISSUE 15) — same input-host loss, but the
+      rebuild short-circuits through ``parallel.migrate``: surviving
+      device state reshards in ICI, the run resumes at the EXACT
+      failure step with NO checkpoint restore (asserted: at least one
+      migrated rebuild, zero ``checkpoint.restore`` fault firings),
+      and the merged loss stream is still bit-exact.
+
+    The first two scenarios pin ``migrate=False`` so the checkpoint
+    path — and its mid-restore fault survival — keeps being proven.
     """
     import numpy as np
 
@@ -313,23 +326,33 @@ def elastic_main(args, plan: dict, root: str) -> int:
           f"2 devices): {args.steps} steps", flush=True)
     ref = elastic_reference_run(args.steps, args.seed)
     scenarios = [
-        ("input_host_loss", (2, 2), (1, 2), 0.0),
-        ("chip_loss", (2, 2), (1, 1), 1e-5),
+        # (name, topo0, topo1, atol, migrate)
+        ("input_host_loss", (2, 2), (1, 2), 0.0, False),
+        ("chip_loss", (2, 2), (1, 1), 1e-5, False),
+        ("migrate_grow_back", (2, 2), (1, 2), 0.0, True),
     ]
     results = []
     failure = None
-    for name, topo0, topo1, atol in scenarios:
+    for name, topo0, topo1, atol, migrate in scenarios:
+        # the migrate scenario never restores, so its planted
+        # mid-restore fault would sit unfired and trip chaos
+        # accounting expectations — drop it from that plan
+        splan = {k: v for k, v in plan.items()
+                 if not (migrate and k == "checkpoint.restore")}
         print(f"[chaos_soak] elastic scenario {name}: "
               f"{topo0[0]} ranks/{topo0[1]} devices -> "
               f"{topo1[0]} ranks/{topo1[1]} devices under plan "
-              f"{json.dumps(plan)}", flush=True)
+              f"{json.dumps(splan)}"
+              + (" (in-memory migrate)" if migrate else ""),
+              flush=True)
         sroot = os.path.join(root, name)
-        if name == "input_host_loss":
+        if topo0[1] == topo1[1]:
             config.set("MXTPU_RESHARD_MODE", "always")
         try:
             losses, runner, events = elastic_soak_run(
-                args.steps, args.seed, args.ckpt_every, sroot, plan,
-                plan_seed=args.seed, topo0=topo0, topo1=topo1)
+                args.steps, args.seed, args.ckpt_every, sroot, splan,
+                plan_seed=args.seed, topo0=topo0, topo1=topo1,
+                migrate=migrate)
         except BaseException as e:  # noqa: BLE001 — report, don't crash
             failure = (f"{name}: soak did not complete: "
                        f"{type(e).__name__}: {e}")
@@ -345,8 +368,8 @@ def elastic_main(args, plan: dict, root: str) -> int:
         # never fired would pass the loss checks trivially — when the
         # plan schedules those faults, refuse to claim the elastic
         # path was exercised unless they actually fired
-        expects_fatal = bool(plan.get("step", {}).get("fatal_calls"))
-        expects_restore = "checkpoint.restore" in plan
+        expects_fatal = bool(splan.get("step", {}).get("fatal_calls"))
+        expects_restore = "checkpoint.restore" in splan
         restore_faults = sum(1 for e in events
                              if e["site"] == "checkpoint.restore")
         if (expects_fatal and runner.incarnation < 1) or \
@@ -357,6 +380,19 @@ def elastic_main(args, plan: dict, root: str) -> int:
                        "fatal lands at step ckpt_every+3; increase "
                        "--steps")
             break
+        if migrate:
+            # the short-circuit contract: EVERY rebuild resumed from
+            # migrated in-memory state — none fell back to a
+            # checkpoint restore. (Counted on the runner itself: the
+            # chaos event log only records sites the plan schedules,
+            # so it cannot witness an unexpected restore.)
+            if runner.migrated_rebuilds < 1 \
+                    or runner.migrated_rebuilds != runner.incarnation:
+                failure = (f"{name}: {runner.migrated_rebuilds} of "
+                           f"{runner.incarnation} rebuild(s) migrated "
+                           "— the rest fell back to the checkpoint "
+                           "path")
+                break
         if atol == 0.0:
             bad = sum(1 for a, b in zip(ref, losses) if a != b)
             if bad:
@@ -373,6 +409,7 @@ def elastic_main(args, plan: dict, root: str) -> int:
         results.append({
             "scenario": name, "from": list(topo0), "to": list(topo1),
             "incarnations": runner.incarnation + 1,
+            "migrated_rebuilds": runner.migrated_rebuilds,
             "faults_injected": len(events),
             "fault_log": events, "exact": atol == 0.0,
             "loss_mismatches": bad,
@@ -397,7 +434,8 @@ def elastic_main(args, plan: dict, root: str) -> int:
     print(f"[chaos_soak] OK: {args.steps} steps x "
           f"{len(results)} elastic scenarios "
           "(input-host loss bit-exact; chip loss within float "
-          "tolerance), reshard-restore survived a mid-restore fault")
+          "tolerance; migrate grow-back bit-exact with zero restores), "
+          "reshard-restore survived a mid-restore fault")
     return 0
 
 

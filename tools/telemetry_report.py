@@ -255,6 +255,29 @@ def summarize(path: str, merge: bool = False) -> str:
             lines.append(f"  !! {bad} checkpoint write(s) failed before "
                          "commit (torn writes are never visible; see "
                          "docs/RESILIENCE.md)")
+    migs: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "migrate":
+            migs.setdefault(r.get("site", "?"), []).append(r)
+    if migs:
+        # in-ICI live resharding (ISSUE 15): one record per device->
+        # device layout flip; wire bytes are the planned schedule's
+        # exact accounting, host bytes are zero by construction
+        lines.append("")
+        lines.append(f"{'migrate (live reshard)':24s} {'flips':>6s} "
+                     f"{'tensors':>8s} {'moved':>6s} {'wire MiB':>9s} "
+                     f"{'quant':>6s} {'mode':>11s} {'last ms':>8s}")
+        for site in sorted(migs):
+            recs = migs[site]
+            last = recs[-1]
+            lines.append(
+                f"{site:24s} {len(recs):6d} "
+                f"{int(last.get('tensors', 0)):8d} "
+                f"{int(last.get('moved', 0)):6d} "
+                f"{sum(r.get('wire_bytes', 0) for r in recs) / 2**20:9.2f} "
+                f"{str(last.get('quant', 'none')):>6s} "
+                f"{str(last.get('mode', '?')):>11s} "
+                f"{last.get('ms', 0.0):8.1f}")
     coll: Dict[str, Dict] = {}
     for r in records:
         if r.get("kind") == "collective":
@@ -407,6 +430,22 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
         out[f"resilience/{ev}"] = float(n)
     if ck_ms:
         out["resilience/checkpoint_p50_ms"] = _pctl(sorted(ck_ms), 50)
+    # migrate records aggregate per site: flip count + total wire bytes
+    # + the last flip's plan size (the diffable footprint of the
+    # device->device reshard path; a wire_bytes delta between rounds is
+    # a layout-schedule change, a migrations delta is a consumer change)
+    mig_by_site: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("kind") == "migrate":
+            mig_by_site.setdefault(r.get("site", "?"), []).append(r)
+    for site, recs in mig_by_site.items():
+        base = f"migrate/{site}"
+        out[f"{base}/migrations"] = float(len(recs))
+        out[f"{base}/wire_bytes"] = float(
+            sum(r.get("wire_bytes", 0) for r in recs))
+        out[f"{base}/plan_ops"] = float(recs[-1].get("plan_ops", 0))
+        out[f"{base}/peak_host_bytes"] = float(
+            max(r.get("peak_host_bytes", 0) for r in recs))
     for r in records:
         # last collective record per site wins (trainer rebuilds emit one
         # each); the diffable ZeRO/quantization footprint of a run
