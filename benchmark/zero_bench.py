@@ -21,10 +21,17 @@ Every row rides the PR 4 JSONL sink (``kind: "bench"``, metric
 ``(param+opt bytes/chip, stage 0) / (param+opt bytes/chip, stage 3)``
 — the ZeRO-3 memory reduction (acceptance: >= 4x on 8 devices).
 
+``--overlap`` runs the ISSUE 18 latency-hiding matrix instead: overlap
+{on, off} x stage {2, 3} x quant {none, int8} over a deep homogeneous
+tower, reporting engagement, the schedule-exact hidden-gather fraction
+and warm-up bytes, wall/step, and asserting the overlapped loss stream
+bitwise equal to the non-overlapped one (metric
+``zero_overlap_detail`` on the JSONL sink).
+
 Standalone::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python benchmark/zero_bench.py
+        python benchmark/zero_bench.py [--overlap]
 """
 
 from __future__ import annotations
@@ -153,6 +160,115 @@ def sweep(steps: int = STEPS):
     return out
 
 
+def _build_deep(cfg, stage, quant, overlap, optimizer="sgd"):
+    """A HOMOGENEOUS tower (head + L identical hidden blocks + tail) —
+    the shape ``zero.layer_plan`` can group; the main sweep's 3-distinct-
+    width models are deliberately NOT groupable and document the
+    fallback."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.config import config
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    config.set("MXTPU_ZERO_OVERLAP", overlap)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(cfg["hidden"], in_units=cfg["in_units"],
+                     activation="tanh"))
+    for _ in range(cfg["layers"]):
+        net.add(nn.Dense(cfg["hidden"], in_units=cfg["hidden"],
+                         activation="tanh"))
+    net.add(nn.Dense(cfg["out"], in_units=cfg["hidden"]))
+    net.initialize(init="xavier")
+    mesh = parallel.make_mesh({"data": -1})
+    return parallel.SPMDTrainer(
+        net, gluon.loss.L2Loss(), optimizer, {"learning_rate": 1e-2},
+        mesh=mesh, donate=False, zero_stage=stage,
+        collective_quant=quant)
+
+
+OVERLAP_CFG = dict(in_units=256, hidden=512, out=64, batch=128, layers=6)
+
+
+def overlap_sweep(steps: int = STEPS):
+    """The ISSUE 18 matrix: overlap {on, off} x stage {2, 3} x quant
+    {none, int8} over the deep homogeneous tower. Per cell: wall/step,
+    engagement + recorded fallback reason, and the static-schedule comm
+    accounting (run all-gather bytes, warm-up overhead, the fraction of
+    gather latency the double buffer hides — exact from the schedule;
+    this box cannot time ICI). Rows ride the PR 4 JSONL sink
+    (``kind: "bench"``, metric ``zero_overlap_detail``); the bit-exact
+    loss check vs the non-overlapped body rides every stage-3 pair."""
+    import time
+
+    import jax
+
+    from incubator_mxnet_tpu.config import config
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "overlap bench needs >= 2 devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 on a 1-chip host)")
+    cfg = OVERLAP_CFG
+    rs = np.random.RandomState(0)
+    x = rs.rand(cfg["batch"], cfg["in_units"]).astype(np.float32)
+    y = rs.rand(cfg["batch"], cfg["out"]).astype(np.float32)
+    rows = {}
+    try:
+        for stage in (2, 3):
+            for quant in ("none", "int8"):
+                cell_losses = {}
+                for overlap in ("off", "on"):
+                    tr = _build_deep(cfg, stage, quant, overlap)
+                    t0 = time.perf_counter()
+                    losses = [float(tr.step(x, y)) for _ in range(steps)]
+                    wall_s = time.perf_counter() - t0
+                    cell_losses[overlap] = losses
+                    info = tr.zero_overlap or {}
+                    row = {
+                        "model": "tower", "stage": stage, "quant": quant,
+                        "overlap": overlap, "losses": losses,
+                        "wall_s_per_step": wall_s / steps,
+                        "engaged": bool(info.get("engaged")),
+                        "reason": info.get("reason"),
+                        "layers": info.get("layers", 0),
+                        "gather": info.get("gather"),
+                        "overlap_fraction":
+                            float(info.get("overlap_fraction", 0.0)),
+                        "run_ag_bytes_per_step":
+                            float(info.get("run_ag_bytes_per_step", 0.0)),
+                        "overlap_extra_ag_bytes_per_step": float(
+                            info.get("overlap_extra_ag_bytes_per_step",
+                                     0.0)),
+                    }
+                    rows[(stage, quant, overlap)] = row
+                    _jsonl_emit({"kind": "bench",
+                                 "metric": "zero_overlap_detail",
+                                 **{k: v for k, v in row.items()
+                                    if k != "losses"}})
+                # the numerics contract, asserted in the bench itself:
+                # overlapped losses == non-overlapped losses, bitwise
+                bit = all(
+                    np.float32(a).tobytes() == np.float32(b).tobytes()
+                    for a, b in zip(cell_losses["on"], cell_losses["off"]))
+                rows[(stage, quant, "on")]["losses_bit_exact_vs_off"] = bit
+                if not bit:
+                    raise RuntimeError(
+                        f"overlap loss stream diverged at stage {stage} "
+                        f"quant {quant}: {cell_losses}")
+    finally:
+        config.unset("MXTPU_ZERO_OVERLAP")
+    return rows
+
+
+def overlap_hidden_fraction(rows) -> float:
+    """Mean over ENGAGED cells of the schedule's hidden-gather fraction
+    ((L-1)/(L+1) of the run's all-gather latency issued under compute)."""
+    fr = [r["overlap_fraction"] for r in rows.values() if r["engaged"]]
+    return float(np.mean(fr)) if fr else 0.0
+
+
 def memory_reduction(rows_by_model) -> float:
     """Geomean over models of (param+opt)/chip at stage 0 over stage 3."""
     factors = []
@@ -175,6 +291,25 @@ def rs_wire_reduction(rows_by_model, quant: str = "int8") -> float:
             factors.append(r["rs_fp32_wire_bytes_per_step"]
                            / r["rs_wire_bytes_per_step"])
     return float(np.exp(np.mean(np.log(factors)))) if factors else 0.0
+
+
+def main_overlap() -> int:
+    rows = overlap_sweep()
+    print(f"{'stage':>5s} {'quant':>5s} {'ovl':>3s} {'eng':>3s} "
+          f"{'L':>2s} {'gather':>17s} {'hidden':>6s} {'AG/step':>11s} "
+          f"{'warmup/step':>11s} {'wall/step':>10s}  reason")
+    for (stage, quant, overlap), r in sorted(
+            rows.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2])):
+        print(f"{stage:5d} {quant:>5s} {overlap:>3s} "
+              f"{'y' if r['engaged'] else 'n':>3s} {r['layers']:2d} "
+              f"{str(r['gather']):>17s} {r['overlap_fraction']:6.2f} "
+              f"{int(r['run_ag_bytes_per_step']):11,d} "
+              f"{int(r['overlap_extra_ag_bytes_per_step']):11,d} "
+              f"{r['wall_s_per_step'] * 1e3:9.2f}m  "
+              f"{r['reason'] or '-'}")
+    print(f"\nhidden gather fraction (engaged cells, schedule-exact): "
+          f"{overlap_hidden_fraction(rows):.3f}")
+    return 0
 
 
 def main() -> int:
@@ -209,4 +344,4 @@ if __name__ == "__main__":
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    sys.exit(main())
+    sys.exit(main_overlap() if "--overlap" in sys.argv else main())

@@ -440,6 +440,26 @@ config.register(
     "gradient magnitudes closer at more scale overhead (4 bytes per "
     "block on the wire). Must be a multiple of 4 for 2bit packing.")
 config.register(
+    "MXTPU_ZERO_OVERLAP", "auto", str,
+    "Latency-hiding ZeRO-3 (docs/SCALING.md 'Latency-hiding ZeRO-3', "
+    "arXiv:2004.13336): 'auto' (default) restructures the stage-3 step "
+    "body into a scan-over-layers with double-buffered param prefetch "
+    "slots — layer i+1's all-gather issues before layer i's matmuls "
+    "consume slot i, forward and backward (the remat re-gather runs the "
+    "same schedule in reverse) — wherever zero.layer_plan can group the "
+    "model, with transparent fallback to the unrolled body otherwise "
+    "(reason on SPMDTrainer.zero_overlap_fallback). 'on' demands the "
+    "scan (raises with MXTPU_ZERO_STRICT when it cannot engage); 'off' "
+    "keeps the PR 10 unrolled body. Bit-exact either way.")
+config.register(
+    "MXTPU_ZERO_STRICT", False, _parse_bool,
+    "Make silent ZeRO degradations hard errors: gluon "
+    "fused_step(zero_stage=3)'s stage-2 fallback raises instead of "
+    "warning, and MXTPU_ZERO_OVERLAP=on raises when the overlap scan "
+    "falls back to the unrolled body. Default off (degrade with "
+    "warning + telemetry: mxtpu_zero_stage_effective, "
+    "mxtpu_zero_overlap_engaged).")
+config.register(
     "MXTPU_DECODE_SLOTS", 8, int,
     "KV-cache slot count of a serving.DecodeSession (the continuous-"
     "batching degree: how many sequences decode concurrently in the one "

@@ -748,26 +748,39 @@ class Trainer:
         the gradient reduction in-graph with the update restricted to
         the owned subset. Stage 3 needs parameters sharded at rest —
         ``parallel.SPMDTrainer`` territory — so the eager trainer
-        degrades it to stage 2 with a warning."""
+        degrades it to stage 2 with a warning (``MXTPU_ZERO_STRICT``
+        turns the degradation into an error); the EFFECTIVE stage is
+        always visible on the ``mxtpu_zero_stage_effective`` gauge."""
         if zero_stage is None:
             zero_stage = 1 if shard_update else 0
         zero_stage = int(zero_stage)
         if zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_stage {zero_stage} not in (0, 1, 2, 3)")
         if zero_stage >= 3:
+            from ..parallel import zero as zero_mod
+
+            why = ("ZeRO-3 keeps parameters sharded at rest, which the "
+                   "eager gluon Trainer cannot express (each process "
+                   "owns full parameters); engaging ZeRO-2. Use "
+                   "parallel.SPMDTrainer(zero_stage=3) for stage 3.")
+            if zero_mod.strict_enabled():
+                raise ValueError("MXTPU_ZERO_STRICT: " + why)
             import warnings
 
-            warnings.warn(
-                "ZeRO-3 keeps parameters sharded at rest, which the "
-                "eager gluon Trainer cannot express (each process owns "
-                "full parameters); engaging ZeRO-2. Use "
-                "parallel.SPMDTrainer(zero_stage=3) for stage 3.")
+            warnings.warn(why)
             self._fused.last_fallback = \
                 "zero-3 degraded to zero-2 (eager trainer keeps full params)"
             zero_stage = 2
         self._fused_mode = bool(enabled)
         self._fused.zero_stage = zero_stage
         self._fused.shard_update = zero_stage >= 1
+        # the degradation above must be visible beyond the one warning:
+        # the gauge reports what the engine will actually run
+        telemetry.gauge(
+            "mxtpu_zero_stage_effective",
+            "ZeRO stage the configured step engine actually runs "
+            "(requests the engine cannot express are degraded here)",
+            site="trainer.step").set(float(zero_stage))
         return self
 
     def superstep(self, net, loss_fn,

@@ -368,3 +368,41 @@ def quantized_payload_bytes(n_elems: int, quant: str, block: int) -> int:
     nb = -(-n_elems // block)
     code_bytes = nb * block if quant == "int8" else nb * block // 4
     return code_bytes + 4 * nb
+
+
+def slot_gather(mesh, axis: str, mode: str = "gspmd"):
+    """Gather/scatter pair for one prefetch SLOT of the latency-hiding
+    ZeRO-3 scan (parallel/zero.py): ``gather`` lifts one layer's
+    at-rest leaves to full, ``scatter`` is its transpose for one
+    layer's cotangents. ``mode='gspmd'`` expresses both as sharding
+    constraints (the SPMD partitioner lowers them to ``all-gather`` /
+    ``reduce-scatter`` ops the latency-hiding scheduler can split into
+    start/done pairs); ``mode='none'`` is the identity — the quantized
+    shard_map body, where parameters already crossed the boundary full
+    and inserting a second in-body gather would re-associate the
+    gradient reduction the error-feedback residual is keyed to."""
+    if mode == "none":
+        def gather(tree):
+            return dict(tree)
+
+        def scatter(tree):
+            return dict(tree)
+
+        return gather, scatter
+    if mode != "gspmd":
+        raise ValueError(f"slot_gather mode {mode!r} not in "
+                         "('gspmd', 'none')")
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    full = NamedSharding(mesh, PartitionSpec())
+    rest = NamedSharding(mesh, PartitionSpec(axis))
+
+    def gather(tree):
+        return {k: jax.lax.with_sharding_constraint(v, full)
+                for k, v in tree.items()}
+
+    def scatter(tree):
+        return {k: jax.lax.with_sharding_constraint(v, rest)
+                for k, v in tree.items()}
+
+    return gather, scatter

@@ -269,6 +269,10 @@ class SPMDTrainer:
                     self.zero_plan.init_residuals(self.params))
         self._batch_sharding = NamedSharding(self.mesh,
                                              PartitionSpec(data_axis))
+        # latency-hiding ZeRO-3 decision record (set per compiled step
+        # signature by _build_step via _note_overlap)
+        self.zero_overlap: Optional[Dict[str, Any]] = None
+        self.zero_overlap_fallback: Optional[str] = None
         if self.zero_plan is not None:
             self.zero_last_stats = self.zero_plan.publish(
                 "spmd.step", self.params, self.opt_state, self.frozen)
@@ -284,7 +288,11 @@ class SPMDTrainer:
             self._wire_counter = None
 
     # -- the fused step -----------------------------------------------------
-    def _build_step(self, n_data: int, n_label: int):
+    def _build_step(self, n_data: int, n_label: int, example=None):
+        # ``example`` = (data_arrays, label_arrays) — arrays or
+        # ShapeDtypeStructs of ONE step's batch, the signature the
+        # overlap planner validates its scan body against (no example ->
+        # the PR 10 unrolled body, reason recorded)
         tx = self.tx
         loss_of = make_functional_loss(self.net, self.loss_fn,
                                        self._trainable, self._frozen)
@@ -302,6 +310,19 @@ class SPMDTrainer:
             # compile it into their loops unchanged
             from . import zero as zero_mod
 
+            # latency-hiding ZeRO-3 (ISSUE 18): swap the unrolled loss
+            # for the double-buffered scan-over-layers body where
+            # layer_plan can group the model — build_step compiles
+            # whichever loss it is handed, so everything downstream
+            # (quantized shard_map, remat, donation) is unchanged
+            ov_loss, info = zero_mod.plan_overlap(
+                self.zero_plan, self.net, self.loss_fn,
+                self._trainable, self._frozen, loss_of,
+                example[0] if example else None,
+                example[1] if example else None)
+            self._note_overlap(info)
+            if ov_loss is not None:
+                loss_of = ov_loss
             return zero_mod.build_step(self.zero_plan, loss_of, tx,
                                        precision)
 
@@ -325,9 +346,29 @@ class SPMDTrainer:
 
         return step
 
-    def _jit_step(self, n_data: int, n_label: int):
-        return jax.jit(self._build_step(n_data, n_label),
+    def _jit_step(self, n_data: int, n_label: int, example=None):
+        return jax.jit(self._build_step(n_data, n_label, example),
                        donate_argnums=(0, 1, 2) if self._donate else ())
+
+    def _note_overlap(self, info: Dict[str, Any]) -> None:
+        """Record the overlap-engagement decision (PR 8 ``last_fallback``
+        style): ``zero_overlap`` holds the planner's info dict,
+        ``zero_overlap_fallback`` the recorded reason whenever the PR 10
+        unrolled body compiles instead of the scan. Publishes the
+        ``mxtpu_zero_overlap_engaged`` gauge and a ``kind:
+        "zero_overlap"`` JSONL record (tools/telemetry_report.py turns
+        ``overlap_fraction`` into ``zero/<site>/overlap_fraction``
+        compare keys)."""
+        self.zero_overlap = dict(info)
+        self.zero_overlap_fallback = info.get("reason")
+        telemetry.gauge(
+            "mxtpu_zero_overlap_engaged",
+            "1 when the double-buffered scan-over-layers ZeRO-3 step "
+            "body is compiled, 0 when the unrolled body runs",
+            site="spmd.step").set(1.0 if info.get("engaged") else 0.0)
+        rec: Dict[str, Any] = {"kind": "zero_overlap", "site": "spmd.step"}
+        rec.update(info)
+        telemetry.jsonl_emit(rec)
 
     @staticmethod
     def _as_jax(x):
@@ -375,7 +416,8 @@ class SPMDTrainer:
         fn = self._step_cache.get(key)
         miss = fn is None
         if miss:
-            fn = self._jit_step(len(data_arrays), len(label_arrays))
+            fn = self._jit_step(len(data_arrays), len(label_arrays),
+                                (data_arrays, label_arrays))
             self._step_cache[key] = fn
         self._num_steps += 1
         rng = _random.next_key()
@@ -426,7 +468,8 @@ class SPMDTrainer:
         label_arrays = [jax.device_put(self._as_jax(l),
                                        self._batch_sharding)
                         for l in labels]
-        fn = self._jit_step(len(data_arrays), len(label_arrays))
+        fn = self._jit_step(len(data_arrays), len(label_arrays),
+                            (data_arrays, label_arrays))
         from .mesh import mesh_scope
 
         try:
@@ -492,7 +535,8 @@ class SPMDTrainer:
         fn = self._step_cache.get(key)
         miss = fn is None
         if miss:
-            raw = self._build_step(len(data_arrays), len(label_arrays))
+            raw = self._build_step(len(data_arrays), len(label_arrays),
+                                   (data_arrays, label_arrays))
 
             def loop(train_p, frozen_p, opt_state, rng, data_arrays,
                      label_arrays):
@@ -620,7 +664,15 @@ class SPMDTrainer:
         fn = self._step_cache.get(key)
         miss = fn is None
         if miss:
-            raw = self._build_step(len(data_arrays), len(label_arrays))
+            # validate the overlap scan against the PER-STEP signature
+            # (the [K, ...] window sliced down one batch)
+            per_step = (
+                [jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                 for a in data_arrays],
+                [jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                 for a in label_arrays])
+            raw = self._build_step(len(data_arrays), len(label_arrays),
+                                   per_step)
 
             def superstep(train_p, frozen_p, opt_state, base_key, c0,
                           data_w, label_w):

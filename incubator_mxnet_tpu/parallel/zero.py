@@ -446,6 +446,14 @@ def build_step(plan: ZeroPlan, loss_of: Callable, tx, precision: str
                     lf, has_aux=True)(train_p, frozen_p, rng,
                                       data_arrays, label_arrays)
                 grads = plan.constrain_grads(grads)
+            # Materialize the gradients before the optimizer consumes
+            # them.  Without the barrier XLA fuses grad-producing ops
+            # into the update elementwise chain, and the fusion (hence
+            # rounding) depends on the loss body's structure — the
+            # overlapped and non-overlapped ZeRO-3 bodies would drift
+            # apart at the ulp level after a few optimizer steps even
+            # though their losses and gradients are bit-identical.
+            loss, grads = jax.lax.optimization_barrier((loss, grads))
             updates, inner = tx.update(grads, inner, train_p)
             train_p = optax.apply_updates(train_p, updates)
             train_p = plan.place_params(train_p)
@@ -459,6 +467,502 @@ def build_step(plan: ZeroPlan, loss_of: Callable, tx, precision: str
         return train_p, frozen_p, opt_state, loss
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# latency-hiding ZeRO-3 (ISSUE 18): scan-over-layers with double-buffered
+# param prefetch slots
+# ---------------------------------------------------------------------------
+#
+# Stage 3 gathers parameters just in time, which serializes gather->matmul
+# per layer — the 8x memory win buys no throughput. The fix (the
+# weight-update-sharding schedule of arXiv:2004.13336): issue layer i+1's
+# all-gather while layer i computes. The step body restructures from the
+# unrolled per-layer JIT gathers into a ``lax.scan`` over a homogeneous
+# run of layers whose carry holds the CURRENT prefetch slot (layer i's
+# gathered params) while the body issues the gather for layer i+1 from the
+# scan's xs (the at-rest shards, rolled by one) — two independent op
+# chains XLA's latency-hiding scheduler is free to hoist apart
+# (``all-gather-start``/``all-gather-done`` with compute between; proven
+# by tests/test_overlap_hlo.py's extended async-pair checker).
+#
+# Memory contract: a naive carry-slot scan would make scan's AD save every
+# iteration's carry — L FULL gathered layers, exactly what stage-3 remat
+# exists to avoid. ``_double_buffered_apply`` therefore defines the
+# backward itself (``jax.custom_vjp``): residuals are the per-layer INPUT
+# activations (batch-sharded) + the at-rest sharded stacks only, and the
+# backward is its own reverse scan with the slots swapped — re-gathering
+# layer i-1 while layer i's grads compute, PR 10's remat re-gather routed
+# through the same prefetch schedule.
+#
+# Numerics contract: bit-exact losses AND grads vs the PR 10 unrolled
+# body (tests/test_zero_overlap.py). The scan applies the SAME ops per
+# layer (validated: identical per-block jaxprs), the per-layer vjp is the
+# same cotangent chain autodiff builds, and grouping never re-associates
+# any accumulation. The quantized path keeps PR 10's shard_map boundary
+# gather (quantizing the weight gather itself would change forward
+# numerics and round-to-zero gradients), so overlap there is the scan
+# restructure with identity slot "gathers" — bit-exact by construction,
+# and the structure later per-layer quantized serving gathers plug into.
+
+OVERLAP_MODES = ("auto", "on", "off")
+
+
+class OverlapIneligible(Exception):
+    """A model/step signature the overlap scan cannot express — carries
+    the human-readable fallback reason (PR 8 ``last_fallback`` style)."""
+
+
+def resolve_overlap(explicit: Optional[str] = None) -> str:
+    """The ``MXTPU_ZERO_OVERLAP`` knob: ``auto`` (default) and ``on``
+    engage the double-buffered scan body wherever ``layer_plan`` can
+    group the model, with transparent fallback to the PR 10 body
+    otherwise (reason recorded; ``on`` + ``MXTPU_ZERO_STRICT`` raises
+    instead); ``off`` never engages."""
+    if explicit is None:
+        from ..config import config
+
+        mode = str(config.get("MXTPU_ZERO_OVERLAP") or "auto")
+    else:
+        mode = str(explicit)
+    mode = mode.strip().lower() or "auto"
+    if mode in ("1", "true", "yes", "always"):
+        mode = "on"
+    elif mode in ("0", "false", "no", "never"):
+        mode = "off"
+    if mode not in OVERLAP_MODES:
+        raise ValueError(f"MXTPU_ZERO_OVERLAP {mode!r} not in "
+                         f"{OVERLAP_MODES}")
+    return mode
+
+
+def strict_enabled() -> bool:
+    """The ``MXTPU_ZERO_STRICT`` knob: silent ZeRO degradations become
+    errors — the gluon ``fused_step(zero_stage=3)`` stage-2 fallback
+    raises, and ``MXTPU_ZERO_OVERLAP=on`` raises when the overlap scan
+    falls back to the unrolled body."""
+    from ..config import config
+
+    return str(config.get("MXTPU_ZERO_STRICT")).strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+class LayerPlan:
+    """Static grouping of a Sequential net's children for the overlap
+    scan: ``head`` (ragged prologue, applied eagerly/unrolled), ``run``
+    (the homogeneous layer stack the scan ranges over), ``tail`` (ragged
+    epilogue). Each entry is ``(child_name, child, suffix_map)`` where
+    ``suffix_map`` maps the child-local param suffix ("weight") to the
+    trainer's flat param name ("3.weight") — the at-rest params STAY
+    flat (the layer-indexed ``[L, ...]`` pytree is stacked in-graph each
+    step), so ``opt/{i}`` checkpoint indices, ``apply_zero_placement``,
+    migrate and serving flips all keep their PR 10 meaning."""
+
+    def __init__(self, head, run, tail):
+        self.head = head
+        self.run = run
+        self.tail = tail
+        self.layers = len(run)
+        self.suffixes = tuple(sorted(run[0][2]))
+        self.run_names = tuple(c for c, _b, _s in run)
+
+    def run_param_names(self):
+        return [suf[s] for _c, _b, suf in self.run for s in self.suffixes]
+
+
+def layer_plan(net, trainable: Dict[str, Any], frozen: Dict[str, Any],
+               plan: ZeroPlan) -> LayerPlan:
+    """Group ``make_functional_loss``'s flat param dict by block prefix
+    into the overlap scan's head/run/tail. Raises
+    :class:`OverlapIneligible` (with the recorded fallback reason) when
+    the model cannot be grouped: not a plain Sequential chain, no
+    contiguous homogeneous run of >= 2 blocks, run blocks carrying
+    frozen params, or run params outside the ZeRO-eligible set."""
+    from ..gluon import nn as _nn
+
+    fwd = getattr(type(net), "forward", None)
+    if fwd not in (_nn.Sequential.forward, _nn.HybridSequential.forward):
+        raise OverlapIneligible(
+            "net is not a plain Sequential/HybridSequential chain "
+            f"({type(net).__name__} overrides forward)")
+    children = list(net._children.items())
+    if len(children) < 2:
+        raise OverlapIneligible("fewer than 2 child blocks")
+    entries = []
+    for cname, child in children:
+        pre = cname + "."
+        t_suf = {n[len(pre):]: n for n in trainable if n.startswith(pre)}
+        f_suf = {n[len(pre):]: n for n in frozen if n.startswith(pre)}
+        own = set(child._collect_params_with_prefix().keys())
+        sig = None
+        if (t_suf and not f_suf and own == set(t_suf)
+                and all(t_suf[s] in plan.eligible for s in t_suf)):
+            sig = (type(child), tuple(sorted(
+                (s, tuple(plan.shapes[t_suf[s]]), str(plan.dtypes[t_suf[s]]))
+                for s in t_suf)))
+        entries.append((cname, child, t_suf, sig))
+    best = (0, 0)
+    i = 0
+    while i < len(entries):
+        j = i
+        while (j < len(entries) and entries[i][3] is not None
+               and entries[j][3] == entries[i][3]):
+            j += 1
+        if entries[i][3] is not None and j - i > best[1] - best[0]:
+            best = (i, j)
+        i = max(j, i + 1)
+    a, b = best
+    if b - a < 2:
+        raise OverlapIneligible(
+            "no contiguous run of >= 2 identical ZeRO-eligible blocks "
+            "to scan over (ragged/heterogeneous model)")
+    strip = [(c, ch, suf) for c, ch, suf, _sig in entries]
+    return LayerPlan(strip[:a], strip[a:b], strip[b:])
+
+
+_OVERLAP_ACT = "zero_overlap_act"
+
+
+def _double_buffered_apply(layer_fn: Callable, gather: Callable, h0,
+                           stacked: Dict[str, Any]):
+    """The overlap scan core: the carry holds ``(activation, slot_i)``
+    — slot i's FULL params, gathered one iteration AHEAD — and the body
+    issues layer i+1's gather from the rolled at-rest shards before
+    layer i's matmuls consume slot i: two independent op chains the
+    latency-hiding scheduler splits into ``all-gather-start`` /
+    compute / ``all-gather-done``. ``gather`` lifts one layer's at-rest
+    leaves to full (GSPMD: a sharding constraint lowering to
+    ``all-gather``; quantized shard_map body: identity — params crossed
+    the boundary full); its AD transpose scatters each layer's
+    cotangent back to the 1/N at-rest spec.
+
+    Memory: plain scan AD would save every carry — L FULL slots,
+    betraying stage 3's 1/N contract. The scan is therefore wrapped in
+    ``jax.checkpoint`` with a ``save_only_these_names`` policy naming
+    ONLY the per-layer output activations: residuals are L
+    batch-sharded activations + the at-rest stacks, and the backward
+    recomputes each slot — the PR 10 remat re-gather routed through the
+    same rolled prefetch schedule, in reverse, slots swapped (the
+    re-gathers sit inside the ``transpose(...)`` while body;
+    tests/test_overlap_hlo.py pins it). Autodiff — not a hand-written
+    reverse scan — builds the backward, so its dots are the exact
+    transposes of the forward's and the grads stay bitwise equal to the
+    unrolled body's."""
+    from jax import lax
+    from jax.ad_checkpoint import checkpoint_name
+
+    def run(h0, stacked):
+        slot0 = gather({s: v[0] for s, v in stacked.items()})
+        xs = {s: jnp.roll(v, -1, axis=0) for s, v in stacked.items()}
+
+        def body(carry, xs_i):
+            h, slot = carry
+            nxt = gather(xs_i)          # issue layer i+1's all-gather...
+            h2 = layer_fn(h, slot)      # ...before layer i's compute
+            h2 = checkpoint_name(h2, _OVERLAP_ACT)
+            return (h2, nxt), None
+
+        (hL, _), _ = lax.scan(body, (h0, slot0), xs)
+        return hL
+
+    run = jax.checkpoint(
+        run, policy=jax.checkpoint_policies.save_only_these_names(
+            _OVERLAP_ACT))
+    return run(h0, stacked)
+
+
+def build_overlap_loss(plan: ZeroPlan, lplan: LayerPlan, loss_fn,
+                       trainable: Dict[str, Any],
+                       frozen: Dict[str, Any]) -> Callable:
+    """Drop-in replacement for ``make_functional_loss``'s closure with
+    the run restructured through :func:`_double_buffered_apply` — same
+    ``(train_p, frozen_p, rng, data, labels) -> (mean_loss, aux)``
+    contract, so :func:`build_step` (and the quantized shard_map body)
+    compile it unchanged. Head/tail children apply eagerly under the
+    full-model trace in original order; scanned blocks must draw no rng
+    and mutate no aux (checked at trace time — ineligibility raises
+    :class:`OverlapIneligible`, which ``plan_overlap``'s validation pass
+    turns into the recorded fallback)."""
+    from .. import autograd
+    from .. import random as _random
+    from ..gluon.block import _Trace
+    from ..gluon.parameter import _trace
+    from ..ndarray import NDArray
+
+    from .collectives import slot_gather
+
+    gspmd = not plan.quantized()
+    mesh, axis = plan.mesh, plan.axis
+    suffixes = lplan.suffixes
+    template = lplan.run[0][1]
+    tmpl_objs = {s: trainable[lplan.run[0][2][s]] for s in suffixes}
+    # the explicit scatter is the gather's AD transpose — autodiff
+    # inserts it for each layer's cotangent (collectives.slot_gather
+    # documents the pair)
+    gather, _scatter = slot_gather(mesh, axis,
+                                   "gspmd" if gspmd else "none")
+
+    def loss_of(train_p, frozen_p, rng, data_arrays, label_arrays):
+        if len(data_arrays) != 1:
+            raise OverlapIneligible(
+                "overlap scan supports single-data-input models")
+        param_map = {id(p): NDArray(train_p[n])
+                     for n, p in trainable.items()}
+        param_map.update({id(p): NDArray(frozen_p[n])
+                          for n, p in frozen.items()})
+        tr = _Trace(param_map)
+        _trace.stack.append(tr)
+        try:
+            with _random.key_provider(rng) as kp, \
+                    autograd._RecordingStateScope(False, True):
+                x = NDArray(data_arrays[0])
+                for _c, child, _s in lplan.head:
+                    x = child(x)
+
+                def layer_fn(h, slot):
+                    c0 = kp._count
+                    pm = {id(tmpl_objs[s]): NDArray(slot[s]) for s in slot}
+                    tr2 = _Trace(pm)
+                    _trace.stack.append(tr2)
+                    try:
+                        out = template(NDArray(h))
+                    finally:
+                        _trace.stack.pop()
+                    if tr2.aux:
+                        raise OverlapIneligible(
+                            "scanned block mutates auxiliary state "
+                            "(running statistics)")
+                    if kp._count != c0:
+                        raise OverlapIneligible(
+                            "scanned block draws per-step randomness")
+                    return out._data
+
+                stacked = {}
+                for s in suffixes:
+                    v = jnp.stack([train_p[suf[s]]
+                                   for _c, _b, suf in lplan.run])
+                    if gspmd:
+                        v = jax.lax.with_sharding_constraint(
+                            v, NamedSharding(mesh,
+                                             PartitionSpec(None, axis)))
+                    stacked[s] = v
+                h = _double_buffered_apply(layer_fn, gather, x._data,
+                                           stacked)
+                x = NDArray(h)
+                for _c, child, _s in lplan.tail:
+                    x = child(x)
+                labels = [NDArray(a) for a in label_arrays]
+                loss = loss_fn(x, *labels)
+        finally:
+            _trace.stack.pop()
+        loss_val = jnp.mean(loss._data.astype(jnp.float32))
+        id2name = {id(p): n for n, p in frozen.items()}
+        id2name.update({id(p): n for n, p in trainable.items()})
+        aux = {id2name[i]: v for i, (p, v) in tr.aux.items()
+               if i in id2name}
+        return loss_val, aux
+
+    return loss_of
+
+
+def _child_apply(child, objs: Dict[str, Any]) -> Callable:
+    """Pure ``(x, pvals, key) -> y`` application of one child block with
+    its params injected — the per-block function whose jaxpr the
+    homogeneity validation compares across the run."""
+    from .. import autograd
+    from .. import random as _random
+    from ..gluon.block import _Trace
+    from ..gluon.parameter import _trace
+    from ..ndarray import NDArray
+
+    def f(x, pvals, key):
+        pm = {id(p): NDArray(pvals[s]) for s, p in objs.items()}
+        tr = _Trace(pm)
+        _trace.stack.append(tr)
+        try:
+            with _random.key_provider(key) as kp, \
+                    autograd._RecordingStateScope(False, True):
+                out = child(NDArray(x))
+        finally:
+            _trace.stack.pop()
+        if tr.aux:
+            raise OverlapIneligible(
+                "scanned block mutates auxiliary state "
+                "(running statistics)")
+        if kp._count:
+            raise OverlapIneligible(
+                "scanned block draws per-step randomness")
+        return out._data
+
+    return f
+
+
+def _validate_overlap(plan: ZeroPlan, lplan: LayerPlan, ov_loss, base_loss,
+                      trainable_objs, frozen_objs, data_sds, label_sds
+                      ) -> None:
+    """Abstract (eval_shape/jaxpr — no compile, no FLOPs) proof that the
+    scan body computes the unrolled body's function for THIS step
+    signature: (a) every run block lowers to the IDENTICAL jaxpr (an
+    activation-shape-preserving pure function, no rng, no aux) — relu
+    vs tanh twins, ragged shapes, dropout and BatchNorm all fail here;
+    (b) the full overlap loss matches the unrolled loss's output/aux
+    structure. Raises :class:`OverlapIneligible` with the fallback
+    reason."""
+    from .. import autograd
+    from .. import random as _random
+    from ..gluon.block import _Trace
+    from ..gluon.parameter import _trace
+    from ..ndarray import NDArray
+
+    if data_sds is None or label_sds is None:
+        raise OverlapIneligible(
+            "no example batch to validate the scan body against")
+    if len(data_sds) != 1:
+        raise OverlapIneligible(
+            "overlap scan supports single-data-input models")
+
+    def sds(a):
+        return jax.ShapeDtypeStruct(tuple(a.shape), jnp.dtype(a.dtype))
+
+    def localize(arrs):
+        # the quantized path traces the loss INSIDE shard_map: the body
+        # sees the per-device batch shard (in_specs P(axis))
+        out = []
+        for a in arrs:
+            shp = tuple(a.shape)
+            if plan.quantized() and shp and shp[0] % plan.n == 0:
+                shp = (shp[0] // plan.n,) + shp[1:]
+            out.append(jax.ShapeDtypeStruct(shp, jnp.dtype(a.dtype)))
+        return out
+
+    data_sds = localize(data_sds)
+    label_sds = localize(label_sds)
+    tp = {n: sds(p._data._data) for n, p in trainable_objs.items()}
+    fp = {n: sds(p._data._data) for n, p in frozen_objs.items()}
+    key = jax.random.PRNGKey(0)
+
+    def head_out(tp_v, fp_v, d0):
+        pm = {id(p): NDArray(tp_v[n]) for n, p in trainable_objs.items()}
+        pm.update({id(p): NDArray(fp_v[n])
+                   for n, p in frozen_objs.items()})
+        tr = _Trace(pm)
+        _trace.stack.append(tr)
+        try:
+            with _random.key_provider(jax.random.PRNGKey(0)), \
+                    autograd._RecordingStateScope(False, True):
+                x = NDArray(d0)
+                for _c, child, _s in lplan.head:
+                    x = child(x)
+        finally:
+            _trace.stack.pop()
+        return x._data
+
+    x_sds = jax.eval_shape(head_out, tp, fp, data_sds[0])
+    ref = None
+    for cname, child, suf in lplan.run:
+        pv = {s: tp[suf[s]] for s in lplan.suffixes}
+        f = _child_apply(child, {s: trainable_objs[suf[s]]
+                                 for s in lplan.suffixes})
+        out_sds = jax.eval_shape(f, x_sds, pv, key)
+        if (tuple(out_sds.shape), out_sds.dtype) != \
+                (tuple(x_sds.shape), x_sds.dtype):
+            raise OverlapIneligible(
+                f"scanned block {cname} does not preserve the "
+                f"activation shape/dtype ({x_sds.shape} -> "
+                f"{out_sds.shape})")
+        jx = str(jax.make_jaxpr(f)(x_sds, pv, key))
+        if ref is None:
+            ref = jx
+        elif jx != ref:
+            raise OverlapIneligible(
+                f"block {cname} computes a different function than the "
+                "run template (identical shapes, different ops)")
+    base_out = jax.eval_shape(base_loss, tp, fp, key, data_sds, label_sds)
+    ov_out = jax.eval_shape(ov_loss, tp, fp, key, data_sds, label_sds)
+    if jax.tree_util.tree_structure(base_out) != \
+            jax.tree_util.tree_structure(ov_out) or \
+            [(tuple(l.shape), l.dtype)
+             for l in jax.tree_util.tree_leaves(base_out)] != \
+            [(tuple(l.shape), l.dtype)
+             for l in jax.tree_util.tree_leaves(ov_out)]:
+        raise OverlapIneligible(
+            "overlap loss/aux structure deviates from the unrolled body")
+
+
+def overlap_wire_stats(plan: ZeroPlan, lplan: LayerPlan) -> Dict[str, float]:
+    """Static overlap accounting for the engaged scan: the run's
+    all-gather bytes per step, the warm-up overhead (the scan gathers
+    L+1 slots per pass — layer 0 twice: once to prime the pipeline,
+    once discarded from the rolled xs tail), and the fraction of gather
+    latency the double buffer can hide (``(L-1)/(L+1)`` per pass: every
+    gather except the exposed priming one and the wasted tail one
+    issues under the previous layer's compute)."""
+    n, frac = plan.n, (plan.n - 1) / plan.n if plan.n > 1 else 0.0
+    run_bytes = 0.0
+    for name in lplan.run_param_names():
+        elems = int(np.prod(plan.shapes[name])) if plan.shapes[name] else 1
+        run_bytes += elems * plan.dtypes[name].itemsize
+    L = lplan.layers
+    passes = 2 if plan.remat or plan.stage >= 3 else 1
+    ag = passes * run_bytes * frac
+    extra = passes * (run_bytes / L) * frac if L else 0.0
+    hidden = (L - 1) / (L + 1) if L else 0.0
+    return {
+        "run_ag_bytes_per_step": ag,
+        "overlap_extra_ag_bytes_per_step": extra,
+        "overlap_fraction": hidden,
+    }
+
+
+def plan_overlap(plan: ZeroPlan, net, loss_fn, trainable_objs,
+                 frozen_objs, base_loss, data_example, label_example,
+                 *, mode: Optional[str] = None):
+    """Decide overlap engagement for one step signature: returns
+    ``(loss_or_None, info)`` where ``info`` records the decision the
+    PR 8 ``last_fallback`` way (``engaged``, ``reason``, ``layers``,
+    ``mode``, wire/overlap-fraction estimates). ``None`` loss means the
+    PR 10 unrolled body runs — transparently under ``auto``/``on``
+    (``on`` + ``MXTPU_ZERO_STRICT`` raises instead)."""
+    mode = resolve_overlap() if mode is None else mode
+    info: Dict[str, Any] = {"mode": mode, "engaged": False,
+                            "reason": None, "layers": 0,
+                            "gather": None, "overlap_fraction": 0.0}
+
+    def fallback(reason):
+        info["reason"] = reason
+        if mode == "on" and strict_enabled():
+            raise RuntimeError(
+                "MXTPU_ZERO_OVERLAP=on with MXTPU_ZERO_STRICT: the "
+                f"overlap scan cannot engage — {reason}")
+        return None, info
+
+    if mode == "off":
+        return fallback("MXTPU_ZERO_OVERLAP=off")
+    if plan.stage < 3:
+        return fallback("stage < 3: params replicated at rest, no "
+                        "gather to hide")
+    if not plan.ingraph():
+        return fallback("single-shard mesh: nothing to gather")
+    try:
+        lplan = layer_plan(net, trainable_objs, frozen_objs, plan)
+    except OverlapIneligible as e:
+        return fallback(str(e))
+    ov = build_overlap_loss(plan, lplan, loss_fn, trainable_objs,
+                            frozen_objs)
+    try:
+        _validate_overlap(plan, lplan, ov, base_loss, trainable_objs,
+                          frozen_objs, data_example, label_example)
+    except OverlapIneligible as e:
+        return fallback(str(e))
+    except Exception as e:
+        return fallback(f"overlap validation failed: "
+                        f"{type(e).__name__}: {e}")
+    info.update(engaged=True, layers=lplan.layers,
+                run=list(lplan.run_names),
+                gather="gspmd-allgather" if not plan.quantized()
+                else "shardmap-boundary")
+    info.update(overlap_wire_stats(plan, lplan))
+    return ov, info
 
 
 def _build_quantized_grads(plan: ZeroPlan, loss_of: Callable) -> Callable:

@@ -35,16 +35,24 @@ _COMPUTE_OP = re.compile(
     r"=\s*\S+\s+(fusion|dot|convolution|multiply|add|subtract|tanh)\(")
 
 
-def assert_async_overlap(hlo: str) -> str:
+def assert_async_overlap(hlo: str, collective: str = "all-reduce") -> str:
     """Assert the overlap-enabling collective structure of a scheduled
     train-step HLO module; returns which form was found.
 
-    Async form (TPU): every ``all-reduce-start`` has a matching
-    ``all-reduce-done`` AND at least one compute instruction is scheduled
-    between them (the hoisted window the latency-hiding scheduler opened).
-    Sync form (CPU): plain ``all-reduce`` instructions coexist in the one
-    module with compute producers and consumers — the structural
-    prerequisite for the scheduler to hoist at all.
+    ``collective`` names the op family to check: ``all-reduce`` for the
+    gradient reduction (the original PR 3 check) or ``all-gather`` for
+    the ZeRO-3 double-buffered parameter prefetch (ISSUE 18 — the scan
+    body issues layer i+1's gather before layer i's compute, so the
+    scheduler can hoist the ``all-gather-start``/``-done`` pair around
+    those matmuls).
+
+    Async form (TPU): every ``<collective>-start`` has a matching
+    ``<collective>-done`` AND at least one compute instruction is
+    scheduled between them (the hoisted window the latency-hiding
+    scheduler opened). Sync form (CPU): plain ``<collective>``
+    instructions coexist in the one module with compute producers and
+    consumers — the structural prerequisite for the scheduler to hoist
+    at all.
     """
     def defines(ln, op):
         # the DEFINING instruction: op name on the lhs, before '='
@@ -52,34 +60,36 @@ def assert_async_overlap(hlo: str) -> str:
 
     lines = hlo.splitlines()
     starts = [i for i, ln in enumerate(lines)
-              if defines(ln, "all-reduce-start")]
+              if defines(ln, f"{collective}-start")]
     if starts:
         for i in starts:
             done = None
             for j in range(i + 1, len(lines)):
-                if defines(lines[j], "all-reduce-done"):
+                if defines(lines[j], f"{collective}-done"):
                     done = j
                     break
-            assert done is not None, f"unmatched all-reduce-start: {lines[i]}"
+            assert done is not None, \
+                f"unmatched {collective}-start: {lines[i]}"
             between = [ln for ln in lines[i + 1:done]
                        if _COMPUTE_OP.search(ln)
-                       and "all-reduce" not in ln]
+                       and collective not in ln]
             assert between, (
-                "no compute scheduled between all-reduce-start and "
-                f"all-reduce-done (lines {i}-{done}) — the scheduler did "
-                "not hoist the pair apart")
+                f"no compute scheduled between {collective}-start and "
+                f"{collective}-done (lines {i}-{done}) — the scheduler "
+                "did not hoist the pair apart")
         return "async"
     # sync form: collective fused into the same module as the compute
-    ar = [ln for ln in lines if re.search(r"all-reduce(\.\d+)?\s*=|="
-                                          r"\s*\S+\s+all-reduce\(", ln)]
-    assert ar, "no all-reduce instruction in the compiled train step"
+    ar = [ln for ln in lines
+          if re.search(rf"{collective}(\.\d+)?\s*=|="
+                       rf"\s*\S+\s+{collective}\(", ln)]
+    assert ar, f"no {collective} instruction in the compiled train step"
     compute = [ln for ln in lines if _COMPUTE_OP.search(ln)]
     assert compute, "no compute instructions in the compiled train step"
-    # a consumer: some instruction takes an all-reduce result as operand
+    # a consumer: some instruction takes a collective result as operand
     consumers = [ln for ln in lines
-                 if "all-reduce" in ln.split("=", 1)[-1]
-                 and "= " in ln and "all-reduce" not in ln.split("=")[0]]
-    assert consumers, "all-reduce result is never consumed by compute"
+                 if collective in ln.split("=", 1)[-1]
+                 and "= " in ln and collective not in ln.split("=")[0]]
+    assert consumers, f"{collective} result is never consumed by compute"
     return "sync"
 
 
@@ -161,3 +171,96 @@ def test_sync_form_assertion_logic():
         assert_async_overlap(
             "ENTRY %m { %p = f32[2]{0} parameter(0)\n"
             "ROOT %a = f32[2]{0} add(%p, %p) }")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: all-gather pairs — the ZeRO-3 double-buffered prefetch
+# ---------------------------------------------------------------------------
+
+# TPU-style scheduled excerpt for the PARAM-GATHER family: the scan
+# body's all-gather-start for layer i+1 hoisted over layer i's matmul.
+_TPU_STYLE_AG_EXCERPT = """\
+ENTRY %main.77 (p0: f32[64,2048], p1: f32[256,2048]) -> f32[64,2048] {
+  %p0 = f32[64,2048]{1,0} parameter(0)
+  %p1 = f32[256,2048]{1,0} parameter(1)
+  %all-gather-start.2 = f32[2048,2048]{1,0} all-gather-start(f32[256,2048]{1,0} %p1), channel_id=3, replica_groups=[1,8]<=[8], dimensions={0}
+  %dot.9 = f32[64,2048]{1,0} dot(f32[64,2048]{1,0} %p0, f32[64,2048]{1,0} %p0)
+  %fusion.12 = f32[64,2048]{1,0} fusion(f32[64,2048]{1,0} %dot.9), kind=kLoop, calls=%fused_computation.12
+  %all-gather-done.2 = f32[2048,2048]{1,0} all-gather-done(f32[2048,2048]{1,0} %all-gather-start.2)
+  ROOT %dot.10 = f32[64,2048]{1,0} dot(f32[64,2048]{1,0} %fusion.12, f32[2048,2048]{1,0} %all-gather-done.2)
+}
+"""
+
+
+def test_all_gather_async_pair_assertion_logic():
+    """The generalized checker proves the all-gather branch on a
+    TPU-style excerpt: start/done with compute hoisted between passes;
+    an empty window fails."""
+    assert assert_async_overlap(
+        _TPU_STYLE_AG_EXCERPT, collective="all-gather") == "async"
+    lines = _TPU_STYLE_AG_EXCERPT.splitlines()
+    start = next(ln for ln in lines if "all-gather-start" in ln)
+    squeezed = [ln for ln in lines if "all-gather-start" not in ln]
+    done_at = next(i for i, ln in enumerate(squeezed)
+                   if "all-gather-done" in ln)
+    squeezed.insert(done_at, start)
+    with pytest.raises(AssertionError):
+        assert_async_overlap("\n".join(squeezed), collective="all-gather")
+
+
+def _overlap_trainer(n_dev=8):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.config import config
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)
+    config.set("MXTPU_ZERO_OVERLAP", "on")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="tanh"))
+    for _ in range(4):
+        net.add(nn.Dense(16, in_units=16, activation="tanh"))
+    net.add(nn.Dense(8, in_units=16))
+    net.initialize(init="xavier")
+    mesh = parallel.make_mesh({"data": n_dev},
+                              devices=jax.devices()[:n_dev])
+    tr = parallel.SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 1e-2}, mesh=mesh,
+                              zero_stage=3)
+    rs = np.random.RandomState(0)
+    return tr, rs.rand(16, 8).astype(np.float32), \
+        rs.rand(16, 8).astype(np.float32)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+def test_overlap_scan_step_hlo_gathers_inside_loop_bodies():
+    """The lowered overlap step on CPU (sync collective form): the
+    param all-gathers live INSIDE the scan's while-loop bodies — both
+    the forward body (``jvp(checkpoint)``) and the rematerialized
+    backward body (``transpose(...)/rematted_computation``), i.e. the
+    PR 10 remat re-gather rides the same reversed prefetch schedule.
+    That in-loop placement is exactly what the TPU scheduler needs to
+    asyncify each iteration's gather under the previous layer's
+    compute (the async branch is proven on the excerpt above)."""
+    from incubator_mxnet_tpu.config import config
+
+    try:
+        tr, x, y = _overlap_trainer()
+        hlo = tr.step_hlo_text(x, y)
+        assert tr.zero_overlap and tr.zero_overlap["engaged"], \
+            tr.zero_overlap
+    finally:
+        config.unset("MXTPU_ZERO_OVERLAP")
+    assert hlo is not None, "backend exposed no compiled HLO"
+    assert assert_async_overlap(hlo, collective="all-gather") == "sync"
+    metas = [re.search(r'op_name="([^"]*)"', ln).group(1)
+             for ln in hlo.splitlines()
+             if re.search(r"=\s*\S+\s+all-gather\(", ln)
+             and "op_name" in ln]
+    fwd = [m for m in metas if "while/body" in m
+           and "transpose" not in m]
+    bwd = [m for m in metas if "while/body" in m and "transpose" in m
+           and "rematted_computation" in m]
+    assert fwd, f"no forward in-loop all-gather; op_names: {metas}"
+    assert bwd, f"no remat-backward in-loop all-gather; op_names: {metas}"

@@ -568,6 +568,21 @@ def test_gluon_fused_step_zero_ladder():
     with pytest.warns(UserWarning, match="ZeRO-3"):
         tr.fused_step(True, zero_stage=3)
     assert tr._fused.zero_stage == 2
+    # ISSUE 18: the degradation is not silent — the gauge publishes
+    # the stage the engine ACTUALLY runs...
+    g = telemetry.get_registry().find("mxtpu_zero_stage_effective",
+                                      site="trainer.step")
+    assert g is not None and g.value == 2.0
+    assert tr._fused.last_fallback and "zero-3" in tr._fused.last_fallback
+    # ...and the strict knob turns it into an error instead
+    config.set("MXTPU_ZERO_STRICT", "1")
+    try:
+        with pytest.raises(ValueError, match="MXTPU_ZERO_STRICT"):
+            tr.fused_step(True, zero_stage=3)
+    finally:
+        config.unset("MXTPU_ZERO_STRICT")
+    tr.fused_step(True, zero_stage=2)
+    assert g.value == 2.0
     with pytest.raises(ValueError):
         tr.fused_step(True, zero_stage=7)
 

@@ -296,6 +296,24 @@ def summarize(path: str, merge: bool = False) -> str:
                 f"{r.get('quant_fraction', 1.0):11.3f} "
                 f"{int(r.get('param_bytes_per_chip', 0)):13d} "
                 f"{int(r.get('opt_bytes_per_chip', 0)):11d}")
+    ovl: Dict[str, Dict] = {}
+    for r in records:
+        if r.get("kind") == "zero_overlap":
+            ovl[r.get("site", "?")] = r       # last record per site wins
+    if ovl:
+        lines.append("")
+        lines.append(f"{'zero-3 overlap':24s} {'mode':>6s} {'eng':>4s} "
+                     f"{'layers':>6s} {'hidden':>7s} {'AG/step':>12s} "
+                     f"reason")
+        for site in sorted(ovl):
+            r = ovl[site]
+            lines.append(
+                f"{site:24s} {str(r.get('mode', '?')):>6s} "
+                f"{'y' if r.get('engaged') else 'n':>4s} "
+                f"{int(r.get('layers', 0)):6d} "
+                f"{r.get('overlap_fraction', 0.0):7.3f} "
+                f"{r.get('run_ag_bytes_per_step', 0) / 2**20:10.2f}Mi "
+                f"{r.get('reason') or '-'}")
     bench = [r for r in records if r.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -457,6 +475,18 @@ def _comparable_metrics(records: List[Dict]) -> Dict[str, float]:
                 if isinstance(r.get(key), (int, float)):
                     out[f"collective/{site}/{key}"] = float(r[key])
             out[f"collective/{site}/stage"] = float(r.get("stage", 0))
+        # last zero_overlap record per site wins: the latency-hiding
+        # scan's engagement + schedule-exact hidden fraction (ISSUE 18)
+        # — a --compare where engaged flips 1 -> 0 is the overlap
+        # silently falling back to the unrolled body
+        if r.get("kind") == "zero_overlap":
+            site = r.get("site", "?")
+            out[f"zero/{site}/overlap_fraction"] = float(
+                r.get("overlap_fraction", 0.0))
+            out[f"zero/{site}/overlap_engaged"] = \
+                1.0 if r.get("engaged") else 0.0
+            out[f"zero/{site}/overlap_ag_bytes_per_step"] = float(
+                r.get("run_ag_bytes_per_step", 0.0))
     return out
 
 
