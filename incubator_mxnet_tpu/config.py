@@ -247,6 +247,46 @@ config.register(
     "The gauge uses bench.py's canonical formula against the measured "
     "ceiling (MXTPU_BENCH_CEILING_TFS).")
 config.register(
+    "MXTPU_TRACE_SAMPLE", 0.0, float,
+    "Head-based sampling rate for span tracing (telemetry.trace, "
+    "docs/OBSERVABILITY.md 'Tracing & flight recorder'): the fraction "
+    "of new traces (serving/decode requests, top-level step spans) "
+    "that record their span tree into the JSONL/chrome sinks. 0 "
+    "(default) makes every span the shared no-op NULL_SPAN — measured "
+    "within noise; 1 traces everything (debugging).")
+config.register(
+    "MXTPU_TRACE_DUMP_DIR", "", str,
+    "Directory for flight-recorder dumps (trace.dump) and "
+    "trigger-engine profiler captures. The Supervisor dumps the span + "
+    "step-ledger rings here on fatal/hung-step/SIGTERM-preempt "
+    "incidents (atomic tmp+rename; each dump gets a fresh "
+    "sequence-numbered name). Empty (default) disables dumping; the "
+    "in-memory rings still record.")
+config.register(
+    "MXTPU_TRACE_RING", 512, int,
+    "Capacity of each flight-recorder ring (last N finished spans, "
+    "last N step-ledger records). Fixed at first use per process.")
+config.register(
+    "MXTPU_TRACE_TRIGGER", "0", str,
+    "Trigger-driven profiler capture: '1'/'auto' arms one bounded "
+    "jax.profiler capture on an SLO breach (MXTPU_TRACE_SLO_MS) or a "
+    "post-warmup recompile flagged by the watchdog, written under "
+    "MXTPU_TRACE_DUMP_DIR and cross-linked from the trace JSONL "
+    "(event:'trigger'). '0' (default) disables the engine.")
+config.register(
+    "MXTPU_TRACE_SLO_MS", 0.0, float,
+    "Per-request latency SLO (milliseconds) for the trigger engine: "
+    "queue-wait/TTFT observations above it fire a debounced profiler "
+    "capture. 0 (default) = no latency SLO (recompile triggers only).")
+config.register(
+    "MXTPU_TRACE_TRIGGER_DEBOUNCE_S", 300.0, float,
+    "Minimum seconds between trigger-engine captures; breaches inside "
+    "the window are dropped (one capture documents the episode).")
+config.register(
+    "MXTPU_TRACE_TRIGGER_CAPTURE_MS", 500.0, float,
+    "Length of one trigger-engine jax.profiler capture. Bounded so a "
+    "misbehaving SLO cannot keep the profiler running.")
+config.register(
     "MXTPU_DATA_PREFETCH_DEPTH", 2, int,
     "Default number of batches a data.DevicePrefetcher stages on device "
     "ahead of the consumer (docs/DATA.md). 2 is enough to overlap the "

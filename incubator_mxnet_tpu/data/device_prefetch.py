@@ -186,8 +186,13 @@ class DevicePrefetcher:
 
     def _spawn_producer(self):
         from .pipeline import _QueueProducer
+        from ..telemetry import trace
 
         state = {}
+        # the consumer's ambient trace context crosses onto the producer
+        # thread in this closure: staged batches land in the trace of
+        # the loop that spawned the epoch (None when unsampled)
+        tctx = trace.ctx()
 
         def nxt():
             # the epoch iterator is created lazily on the producer
@@ -195,7 +200,10 @@ class DevicePrefetcher:
             # and returns, the copy itself overlaps the running step
             if "it" not in state:
                 state["it"] = iter(self._source)
-            return _tree_place(next(state["it"]), self._place)
+            if tctx is None:
+                return _tree_place(next(state["it"]), self._place)
+            with trace.use(tctx), trace.span("data.stage"):
+                return _tree_place(next(state["it"]), self._place)
 
         self._producer = _QueueProducer(
             nxt, self.depth, self._instruments(),

@@ -588,7 +588,8 @@ class SuperStep:
         base_key, c0 = _random.reserve_keys(k)
         h2d = sum(int(a.nbytes) for a in data_w + label_w)
         try:
-            with self._telemetry.step(h2d_bytes=h2d, count=k), \
+            with telemetry.trace.span("trainer.superstep", k=k), \
+                    self._telemetry.step(h2d_bytes=h2d, count=k), \
                     profiler.scope("gluon.superstep"):
                 new_ws, new_frozen, new_states, losses = jfn(
                     ws, frozen, states,
@@ -833,7 +834,8 @@ class Trainer:
             self._allreduce_grads()
         d0 = self._fused.dispatch_count
         try:
-            with self._telemetry.step(
+            with telemetry.trace.span("trainer.step"), \
+                    self._telemetry.step(
                     flops_fn=lambda: self._fused.last_flops) as sc:
                 self._update(ignore_stale_grad)
                 if sc is not None:

@@ -505,6 +505,9 @@ def migrate_arrays(tree, dest, *, quant: Optional[str] = None,
         else:
             dput.append(i)
     compiled = False
+    from ..telemetry import trace
+
+    sp = trace.span("migrate.flip", site=site)
     try:
         for ids, idxs in groups.items():
             leaf_specs = [(tuple(leaves[i].shape),
@@ -527,8 +530,10 @@ def migrate_arrays(tree, dest, *, quant: Optional[str] = None,
         for i in dput:
             out[i] = jax.device_put(leaves[i], dst_shs[i])
     except MigrateError:
+        sp.end(error="MigrateError")
         raise
     except Exception as e:
+        sp.end(error=type(e).__name__)
         raise MigrateError(f"migration failed to lower/execute: {e}") \
             from e
     moved_leaves = [out[i] for g in groups.values() for i in g] \
@@ -542,6 +547,8 @@ def migrate_arrays(tree, dest, *, quant: Optional[str] = None,
     stats.update(site=site, mode=mode, compiled=compiled,
                  peak_host_bytes=0,
                  wall_s=time.perf_counter() - t0)
+    sp.end(mode=mode, compiled=compiled,
+           wire_bytes=stats["wire_bytes"])
     _publish(stats)
     return jax.tree_util.tree_unflatten(treedef, out)
 

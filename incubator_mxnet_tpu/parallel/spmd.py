@@ -426,7 +426,8 @@ class SPMDTrainer:
         from .mesh import mesh_scope
 
         h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
-        with self._telemetry.step(
+        with telemetry.trace.span("spmd.step", step=self._num_steps), \
+                self._telemetry.step(
                 h2d_bytes=h2d,
                 flops_fn=lambda: self._flops_for(key, data, labels)):
             if miss:
@@ -561,7 +562,9 @@ class SPMDTrainer:
         skey = (tuple((a.shape, str(a.dtype)) for a in data_arrays),
                 tuple((a.shape, str(a.dtype)) for a in label_arrays))
         h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
-        with self._loop_telemetry.step(
+        with telemetry.trace.span("spmd.run_steps", n=n,
+                                  step=self._num_steps), \
+                self._loop_telemetry.step(
                 h2d_bytes=h2d, count=n,
                 flops_fn=lambda: self._flops_for(skey, data, labels)):
             if miss:
@@ -701,7 +704,9 @@ class SPMDTrainer:
                 tuple((a.shape[1:], str(a.dtype)) for a in label_arrays))
         h2d = sum(int(a.nbytes) for a in data_arrays + label_arrays)
         try:
-            with self._superstep_telemetry.step(
+            with telemetry.trace.span("spmd.superstep", k=k,
+                                      step=self._num_steps), \
+                    self._superstep_telemetry.step(
                     h2d_bytes=h2d, count=k,
                     flops_fn=lambda: self._flops_for(
                         skey, [a[0] for a in data_arrays],

@@ -224,12 +224,18 @@ class CheckpointManager:
         runs on the background writer unless ``sync=True`` (or the
         manager was built with ``async_save=False``)."""
         from .. import random as _random
+        from ..telemetry import trace
 
-        snap = _TrainerSnapshot(trainer)
-        data_state = data_iter.state_dict() if data_iter is not None \
-            else None
-        rng = _random.get_state()
-        job = (int(step), snap, data_state, rng)
+        with trace.span("checkpoint.snapshot", step=int(step)) as sp:
+            snap = _TrainerSnapshot(trainer)
+            data_state = data_iter.state_dict() if data_iter is not None \
+                else None
+            rng = _random.get_state()
+        # the trace context crosses the writer-thread hop ON the job:
+        # the caller's ambient span if any, else the snapshot span's own
+        # trace (sp.context is None on the unsampled NULL span)
+        job = (int(step), snap, data_state, rng,
+               trace.ctx() or sp.context)
         if sync or (sync is None and not self.async_save):
             err = self._write(*job)
             if err is not None:
@@ -312,12 +318,22 @@ class CheckpointManager:
             err, self.last_error = self.last_error, None
             raise err
 
-    def _write(self, step: int, snap, data_state, rng
+    def _write(self, step: int, snap, data_state, rng, tctx=None
                ) -> Optional[BaseException]:
         """Write + commit one checkpoint; returns the failure (also
-        stored in ``last_error`` for ``wait()``) or None."""
-        with self._write_lock:
-            return self._write_locked(step, snap, data_state, rng)
+        stored in ``last_error`` for ``wait()``) or None. ``tctx`` is
+        the carried trace context of the scheduling save() — the write
+        span lands in that trace even though it runs on the writer
+        thread."""
+        from ..telemetry import trace
+
+        with trace.use(tctx):
+            sp = trace.span("checkpoint.write", step=step)
+            with self._write_lock:
+                err = self._write_locked(step, snap, data_state, rng)
+        sp.end(**({"error": type(err).__name__} if err is not None
+                  else {}))
+        return err
 
     def _write_locked(self, step: int, snap, data_state, rng
                       ) -> Optional[BaseException]:

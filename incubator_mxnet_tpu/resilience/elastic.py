@@ -135,24 +135,27 @@ class ElasticRunner:
         merged: Dict[int, float] = {}
         incarnation = self.incarnation
         carry: Optional[Dict[str, Any]] = None
+        from ..telemetry import trace
+
         while True:
-            trainer, feed = self.build_fn(incarnation)
-            self.manager = CheckpointManager(self.root,
-                                             **self.manager_kwargs)
-            self.supervisor = Supervisor(
-                trainer, self.manager,
-                capture_entry_state=self.migrate_enabled,
-                **self.supervisor_kwargs)
-            self.incarnation = incarnation
-            start_step = None
-            if carry is not None:
-                # the ISSUE 15 short-circuit: surviving device state
-                # migrates onto the new topology and the run resumes at
-                # the exact failure step — the checkpoint restore (the
-                # old always-re-restore path) only runs when migration
-                # is not possible
-                start_step = self._migrate_in(carry, trainer, feed)
-                carry = None
+            with trace.span("elastic.rebuild", incarnation=incarnation):
+                trainer, feed = self.build_fn(incarnation)
+                self.manager = CheckpointManager(self.root,
+                                                 **self.manager_kwargs)
+                self.supervisor = Supervisor(
+                    trainer, self.manager,
+                    capture_entry_state=self.migrate_enabled,
+                    **self.supervisor_kwargs)
+                self.incarnation = incarnation
+                start_step = None
+                if carry is not None:
+                    # surviving device state migrates onto the new
+                    # topology and the run resumes at the exact failure
+                    # step — the checkpoint restore (the old
+                    # always-re-restore path) only runs when migration
+                    # is not possible
+                    start_step = self._migrate_in(carry, trainer, feed)
+                    carry = None
             try:
                 out = self.supervisor.run(feed, steps=steps,
                                           start_step=start_step)

@@ -252,6 +252,7 @@ class Supervisor:
             if self._preempt.is_set():
                 self._checkpoint(feed, sync=True)
                 self._emit({"event": "preempted", "step": self.step_num})
+                self._flight_dump("preempt")
                 raise Preempted(self.step_num)
             if self.capture_entry_state:
                 # BEFORE the batch is pulled and before any RNG draw,
@@ -459,6 +460,7 @@ class Supervisor:
             self._t_hung.inc()
             self._emit({"event": "hung_step", "step": self.step_num,
                         "deadline_s": round(deadline, 3)})
+            self._flight_dump("hung_step")
             raise
         finally:
             if use_alarm:
@@ -472,6 +474,7 @@ class Supervisor:
             self._emit({"event": "hung_step", "step": self.step_num,
                         "deadline_s": round(deadline, 3),
                         "wall_s": round(dt, 3)})
+            self._flight_dump("hung_step")
         # fallback EMA stays per-STEP: amortize the call's wall time
         # over the steps it actually executed (a tail superstep runs
         # fewer than the nominal k)
@@ -501,6 +504,10 @@ class Supervisor:
         """Fatal path: restore the newest valid checkpoint and resume
         from its step; re-raise when restarts are exhausted or there is
         nothing to restore from."""
+        # the black box first: the flight recorder still holds the step
+        # ledger and spans leading INTO the fatal — a failed restore
+        # below must not lose them
+        self._flight_dump("fatal")
         if self.manager is None:
             raise exc
         if self.restarts >= self.max_restarts:
@@ -528,6 +535,15 @@ class Supervisor:
                      restored, exc)
         self.step_num = restored
         return iter(feed)                  # pipeline state was rewound
+
+    @staticmethod
+    def _flight_dump(reason: str) -> None:
+        """Ship the flight recorder's black box on an incident path
+        (fatal / hung step / SIGTERM preempt) — best-effort, never
+        raises, no-op unless ``MXTPU_TRACE_DUMP_DIR`` is set."""
+        from ..telemetry import trace
+
+        trace.incident_dump(reason)
 
     def _emit(self, record: Dict[str, Any]) -> None:
         from .. import telemetry

@@ -25,6 +25,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry import trace
 from .metrics import ServingMetrics
 
 
@@ -76,7 +77,7 @@ class DynamicBatcher:
         self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self.metrics = metrics if metrics is not None else ServingMetrics(name)
         self._cv = threading.Condition()
-        self._queue: deque = deque()   # (example, t_submit, future)
+        self._queue: deque = deque()   # (example, t_submit, future, span)
         self._state = "running"        # -> "draining" -> / "closed"
         self._feature_sig: Optional[Tuple] = None
         self._ewma_batch_s = 0.0       # service-time estimate for retry_after
@@ -110,7 +111,12 @@ class DynamicBatcher:
                     f"queue full ({self.max_queue} waiting)",
                     retry_after=self._retry_after_locked())
             fut: Future = Future()
-            self._queue.append((arr, time.monotonic(), fut))
+            # the trace context crosses the queue ON the tuple: a
+            # sampled request's "queue" span starts here (caller
+            # thread) and ends when the worker assembles its batch;
+            # unsampled requests carry None at zero cost
+            tq = trace.start("queue")
+            self._queue.append((arr, time.monotonic(), fut, tq))
             self.metrics.observe_queue_depth(len(self._queue))
             self._cv.notify_all()
             return fut
@@ -176,42 +182,72 @@ class DynamicBatcher:
             items = [self._queue.popleft() for _ in range(k)]
             self.metrics.observe_queue_depth(len(self._queue))
             retry_after = self._retry_after_locked() if shed else 0.0
-        for _, _, f in shed:           # futures resolve outside the lock
+        for _, _, f, tq in shed:       # futures resolve outside the lock
             self.metrics.observe_shed()
+            if tq is not None:
+                tq.end(shed=True)
             if not f.done():
                 f.set_exception(DeadlineExceededError(
                     f"request exceeded its {self.deadline_ms:.1f} ms "
                     "deadline while queued", retry_after=retry_after))
         return items
 
+    @staticmethod
+    def _trace_parent(tq):
+        """The request root the worker-side spans attach to: the
+        "queue" span's parent when the submit happened under a server
+        root, else the queue span itself (bare-batcher use)."""
+        return tq.parent_context() or tq.context
+
     def _run_batch(self, items: List[Tuple]) -> None:
-        futures = [f for _, _, f in items]
+        futures = [f for _, _, f, _ in items]
+        # the worker side of the thread hop: every carried "queue" span
+        # ends at batch assembly; dispatch/depad are recorded under the
+        # same request roots with the shared batch interval
+        for _, _, _, tq in items:
+            if tq is not None:
+                tq.end()
         t0 = time.perf_counter()
         try:
-            batch = np.stack([x for x, _, _ in items])
+            batch = np.stack([x for x, _, _, _ in items])
             out = self._runner(batch)
         except Exception as exc:       # noqa: BLE001 — failure -> callers
-            for f in futures:
+            t1 = time.perf_counter()
+            for _, _, f, tq in items:
+                if tq is not None:
+                    trace.record(self._trace_parent(tq), "dispatch",
+                                 t0, t1, batch=len(items),
+                                 error=type(exc).__name__)
                 if not f.done():
                     f.set_exception(exc)
             return
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         self._ewma_batch_s = dt if not self._ewma_batch_s \
             else 0.8 * self._ewma_batch_s + 0.2 * dt
         self.metrics.observe_batch(len(items))
         now = time.monotonic()
         leaves = out if isinstance(out, tuple) else (out,)
-        for i, (_, t_submit, f) in enumerate(items):
+        for i, (_, t_submit, f, _) in enumerate(items):
             # per-future guard: a runner output whose leading axis is not
             # the batch axis must fail THAT caller, not kill the worker
             try:
                 row = tuple(leaf[i] for leaf in leaves)
                 self.metrics.observe_latency(now - t_submit)
+                trace.note_latency(f"serving.{self.metrics.model}",
+                                   now - t_submit)
                 if not f.done():
                     f.set_result(row[0] if len(row) == 1 else row)
             except Exception as exc:   # noqa: BLE001
                 if not f.done():
                     f.set_exception(exc)
+        t2 = time.perf_counter()
+        for _, _, _, tq in items:
+            if tq is not None:
+                parent = self._trace_parent(tq)
+                trace.record(parent, "dispatch", t0, t1,
+                             batch=len(items))
+                trace.record(parent, "depad", t1, t2)
 
     def _loop(self) -> None:
         while True:
@@ -223,7 +259,7 @@ class DynamicBatcher:
             try:
                 self._run_batch(items)
             except Exception as exc:   # noqa: BLE001 — worker must survive
-                for _, _, f in items:
+                for _, _, f, _ in items:
                     if not f.done():
                         f.set_exception(exc)
 
@@ -249,7 +285,9 @@ class DynamicBatcher:
             pending = list(self._queue)
             self._queue.clear()
             self._cv.notify_all()
-        for _, _, f in pending:
+        for _, _, f, tq in pending:
+            if tq is not None:
+                tq.end(error="ServerClosedError")
             if not f.done():
                 f.set_exception(ServerClosedError("server closed"))
         self._worker.join(timeout=join_timeout)

@@ -131,6 +131,8 @@ class DecodeHandle:
         self._exc: Optional[BaseException] = None
         self._cb_lock = threading.Lock()
         self._callbacks: List = []
+        #: trace id of the request's sampled root span (None unsampled)
+        self.trace_id: Optional[str] = None
 
     # -- session side -------------------------------------------------------
     def _put(self, tok: int) -> None:
@@ -212,7 +214,11 @@ class DecodeHandle:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "eos_id", "t_submit", "handle")
+    # ``trace`` is the request's root span (or None when unsampled) and
+    # ``t_submit_p`` its perf_counter twin of t_submit: the trace
+    # context crosses the scheduler thread hop ON the request object
+    __slots__ = ("prompt", "max_new", "eos_id", "t_submit", "t_submit_p",
+                 "handle", "trace")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  eos_id: Optional[int]):
@@ -220,16 +226,23 @@ class _Request:
         self.max_new = max_new
         self.eos_id = eos_id
         self.t_submit = time.monotonic()
+        self.t_submit_p = time.perf_counter()
         self.handle = DecodeHandle()
+        self.trace = None
+
+    def _end_trace(self, **attrs) -> None:
+        if self.trace is not None:
+            self.trace.end(**attrs)
 
 
 class _Active:
-    __slots__ = ("req", "generated", "t_admitted")
+    __slots__ = ("req", "generated", "t_admitted", "t0_steps")
 
     def __init__(self, req: _Request):
         self.req = req
         self.generated = 0
         self.t_admitted = time.monotonic()
+        self.t0_steps: Optional[float] = None   # first decode-step start
 
 
 
@@ -355,6 +368,7 @@ class DecodeSession:
             daemon=True)
         self._worker.start()
         telemetry.maybe_start_http()
+        telemetry.register_health(f"decode.{self.name}", self.healthz)
 
     # -- construction from artifacts -----------------------------------------
     @classmethod
@@ -698,6 +712,12 @@ class DecodeSession:
             self._pending.append(req)
             self._cv.notify_all()
         self.metrics.observe_submit()
+        # request root span minted at the front door (caller thread);
+        # the context rides the _Request across the scheduler hop
+        req.trace = telemetry.trace.start("decode.request",
+                                          model=self.name, prompt_len=n)
+        if req.trace is not None:
+            req.handle.trace_id = req.trace.trace_id
         return req.handle
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
@@ -733,6 +753,7 @@ class DecodeSession:
                 self.metrics.observe_shed()
                 with self._cv:
                     retry_after = self._retry_after_locked()
+                req._end_trace(error="DeadlineExceededError", shed=True)
                 req.handle._fail(DeadlineExceededError(
                     f"request exceeded its {self.deadline_ms:.1f} ms "
                     "deadline while queued", retry_after=retry_after))
@@ -740,6 +761,7 @@ class DecodeSession:
                 try:
                     self._prefill_into(slot, req)
                 except Exception as exc:   # noqa: BLE001 — fail the caller
+                    req._end_trace(error=type(exc).__name__)
                     req.handle._fail(exc)
                     with self._cv:
                         # idempotent recovery: close() may have already
@@ -762,6 +784,7 @@ class DecodeSession:
                             self._slots[i] = None
                             self._free.append(i)
                     for _, s in active:
+                        s.req._end_trace(error=type(exc).__name__)
                         s.req.handle._fail(exc)
 
     def _wait_for_work(self):
@@ -810,18 +833,31 @@ class DecodeSession:
         through the length-bucketed cache, join the K/V planes into the
         slot's cache range, emit the first greedy token."""
         n = int(req.prompt.shape[0])
+        root = req.trace
         t0 = time.perf_counter()
         with profiler.scope(f"decode::{self.name}::prefill"), \
                 telemetry.attribute(f"decode.{self.name}",
                                     detail=f"prefill len={n}"):
             first, k_pad, v_pad = self._prefill(req.prompt)
+            t_pf1 = time.perf_counter()
             join = self._join_exec(self._prefill.bucket_for(n))
             self._kv.k, self._kv.v = join(self._kv.k, self._kv.v, k_pad,
                                           v_pad, jnp.asarray(slot,
                                                              jnp.int32))
             first_tok = int(first)                    # the D2H fence
-        dt = time.perf_counter() - t0
+        t_fence = time.perf_counter()
+        dt = t_fence - t0
         now = time.monotonic()
+        if root is not None:
+            # contiguous perf-clock segments of the TTFT critical path:
+            # queue (submit -> admission), prefill (dispatch -> device
+            # done for the bucketed prompt pass), join (K/V splice +
+            # the D2H fence that makes the first token host-visible)
+            telemetry.trace.record(root, "queue", req.t_submit_p, t0,
+                                   slot=slot)
+            telemetry.trace.record(root, "prefill", t0, t_pf1,
+                                   bucket=self._prefill.bucket_for(n))
+            telemetry.trace.record(root, "join", t_pf1, t_fence)
         with self._cv:
             st = self._slots[slot]
             if st is None:                 # closed underneath the prefill
@@ -831,6 +867,12 @@ class DecodeSession:
         st.generated = 1
         self.metrics.observe_admit(st.t_admitted - req.t_submit, dt)
         self.metrics.observe_first_token(now - req.t_submit)
+        if root is not None:
+            # the measured TTFT on the SAME perf clock the segments use
+            root.annotate(ttft_ms=round((t_fence - req.t_submit_p) * 1e3,
+                                        3))
+        telemetry.trace.note_latency(f"decode.{self.name}",
+                                     now - req.t_submit)
         self.metrics.observe_prefill_token()
         req.handle._put(first_tok)
         # capacity cannot end a sequence here: submit() rejects prompts
@@ -861,9 +903,11 @@ class DecodeSession:
                     self._params, self._kv.k, self._kv.v,
                     jnp.asarray(cache_len), jnp.asarray(tokens))
                 nxt_np = np.asarray(nxt)              # the D2H fence
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         self.metrics.observe_step(k, dt, k)
         finished: List[int] = []
+        first_steps: List[_Request] = []
         with self._cv:
             for i in active:
                 st = self._slots[i]
@@ -874,9 +918,16 @@ class DecodeSession:
                 self._tokens[i] = tok
                 st.generated += 1
                 st.req.handle._put(tok)
+                if st.t0_steps is None:
+                    st.t0_steps = t0
+                    if st.req.trace is not None:
+                        first_steps.append(st.req)
                 if (tok == st.req.eos_id or st.generated >= st.req.max_new
                         or self._cache_len[i] >= self.max_len):
                     finished.append(i)
+        for req in first_steps:
+            telemetry.trace.record(req.trace, "first_step", t0, t1,
+                                   active=k)
         for i in finished:
             self._finish_slot(i)
         self.metrics.observe_slots(self.active_slots)
@@ -902,6 +953,13 @@ class DecodeSession:
             self._tokens[slot] = 0
             self._cv.notify_all()
         st.req.handle._finish()
+        if st.req.trace is not None:
+            if st.t0_steps is not None:
+                telemetry.trace.record(st.req.trace, "steps",
+                                       st.t0_steps, time.perf_counter(),
+                                       tokens=st.generated)
+            st.req._end_trace(new_tokens=st.generated,
+                              slots_active=n_active)
         self.metrics.observe_finish()
         now = time.monotonic()
         telemetry.jsonl_emit({
@@ -940,6 +998,7 @@ class DecodeSession:
 
     def close(self, join_timeout: float = 5.0) -> None:
         """Immediate: fail queued and active requests, stop the worker."""
+        telemetry.unregister_health(f"decode.{self.name}")
         with self._cv:
             self._state = "closed"
             pending = list(self._pending)
@@ -952,8 +1011,10 @@ class DecodeSession:
                 swap["applied"].set()   # waiting publisher fails fast
             self._cv.notify_all()
         for req in pending:
+            req._end_trace(error="ServerClosedError")
             req.handle._fail(ServerClosedError("decode session closed"))
         for st in active:
+            st.req._end_trace(error="ServerClosedError")
             st.req.handle._fail(ServerClosedError("decode session closed"))
         self._worker.join(timeout=join_timeout)
 

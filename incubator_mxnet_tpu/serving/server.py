@@ -237,6 +237,9 @@ class ModelServer:
         self._weights_version: object = 0   # bumped by publish_weights
         self._swap_lock = threading.Lock()  # serializes publishers only
         telemetry.maybe_start_http()
+        # the exporter's /healthz aggregates every live server: a fleet
+        # front door probes one port per process (docs/OBSERVABILITY.md)
+        telemetry.register_health(f"serving.{self.name}", self.healthz)
 
     # -- construction from artifacts -----------------------------------------
     @classmethod
@@ -310,8 +313,26 @@ class ModelServer:
     def submit(self, example) -> Future:
         """Enqueue one example (feature shape, no batch axis); resolves to
         the model output row (or tuple of rows for multi-output nets).
-        Raises ``QueueFullError`` (backpressure) / ``ServerClosedError``."""
-        return self._batcher.submit(example)
+        Raises ``QueueFullError`` (backpressure) / ``ServerClosedError``.
+
+        The serving front door for traces: a head-sampled request gets
+        a root ``serving.request`` span here whose tree (queue →
+        dispatch → depad) follows the request across the batcher's
+        worker thread; the trace id rides the returned future as
+        ``fut.trace_id``."""
+        root = telemetry.trace.start("serving.request", model=self.name)
+        if root is None:
+            return self._batcher.submit(example)
+        try:
+            with telemetry.trace.use(root):
+                fut = self._batcher.submit(example)
+        except BaseException as exc:
+            root.end(error=type(exc).__name__)
+            raise
+        fut.trace_id = root.trace_id
+        fut.add_done_callback(
+            lambda f: root.end(ok=f.exception() is None))
+        return fut
 
     def predict(self, example, timeout: Optional[float] = 60.0):
         """Synchronous ``submit`` — one request through the batcher."""
@@ -418,6 +439,7 @@ class ModelServer:
 
     def close(self) -> None:
         """Immediate: fail queued requests, stop the worker."""
+        telemetry.unregister_health(f"serving.{self.name}")
         self._batcher.close()
 
     def maintenance(self):

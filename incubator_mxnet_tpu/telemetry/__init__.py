@@ -45,6 +45,7 @@ from .meters import (StepMeter, aot_flops, ceiling_tfs, mfu_percent,
                      device_memory_stats, flops_of_compiled)
 from .watchdog import (COMPILE_EVENTS, RecompileEvent, RecompileWatchdog,
                        attribute, current_attribution, probe_scope)
+from . import trace
 
 __all__ = [
     "COMPILE_EVENTS", "Counter", "DEFAULT_TIME_BUCKETS", "Gauge",
@@ -53,10 +54,11 @@ __all__ = [
     "StepMeter", "aot_flops", "attribute", "ceiling_tfs", "counter",
     "current_attribution", "device_memory_stats", "enabled",
     "flops_of_compiled", "gauge", "get_registry", "get_watchdog",
-    "histogram", "jsonl_emit", "jsonl_sink", "maybe_start_http",
-    "mfu_enabled", "mfu_percent", "note_cache_miss", "probe_scope",
-    "prometheus_text", "read_jsonl", "reset",
-    "sanitize_metric_name", "set_jsonl", "serve_metrics",
+    "healthz_status", "histogram", "jsonl_emit", "jsonl_sink",
+    "maybe_start_http", "mfu_enabled", "mfu_percent", "note_cache_miss",
+    "probe_scope", "prometheus_text", "read_jsonl", "register_health",
+    "reset", "sanitize_metric_name", "set_jsonl", "serve_metrics",
+    "trace", "unregister_health",
 ]
 
 _lock = threading.Lock()
@@ -66,6 +68,7 @@ _jsonl_cfg: Optional[str] = None  # config value the sink currently reflects
 _jsonl_pinned = False  # set_jsonl() took ownership; stop following config
 _http: Optional[MetricsHTTPServer] = None
 _http_failed_port: Optional[int] = None
+_health: Dict[str, object] = {}   # name -> zero-arg callable -> dict
 
 
 def enabled() -> bool:
@@ -229,6 +232,46 @@ def jsonl_emit(record: Dict) -> None:
         sink.emit(record)
 
 
+# -- health providers (the /healthz endpoint) -------------------------------
+def register_health(name: str, provider) -> None:
+    """Register a zero-arg callable returning a health dict (the
+    ``ModelServer.healthz()`` shape: truthy ``ready`` = serving). The
+    exporter's ``/healthz`` endpoint aggregates every registered
+    provider — a fleet front door probes ONE port per process. Last
+    registration per name wins (a rebuilt replica re-registers)."""
+    with _lock:
+        _health[name] = provider
+
+
+def unregister_health(name: str) -> None:
+    with _lock:
+        _health.pop(name, None)
+
+
+def healthz_status() -> tuple:
+    """(ready, payload) aggregated over the registered providers. No
+    providers — the process is up and exporting, which is all a liveness
+    probe can ask — reports ready. A provider that raises is reported
+    unready with the error, never propagated into the HTTP thread."""
+    with _lock:
+        providers = dict(_health)
+    payload: Dict[str, object] = {}
+    ready = True
+    for name, fn in sorted(providers.items()):
+        try:
+            h = fn()
+        except Exception as e:     # noqa: BLE001 — probe must not die
+            h = {"ready": False, "error": f"{type(e).__name__}: {e}"}
+        if isinstance(h, dict):
+            payload[name] = h
+            ready = ready and bool(h.get("ready", True))
+        else:
+            payload[name] = {"ready": bool(h)}
+            ready = ready and bool(h)
+    return ready, {"status": "ok" if ready else "unready",
+                   "providers": payload}
+
+
 # -- /metrics HTTP ----------------------------------------------------------
 def serve_metrics(port: Optional[int] = None,
                   host: Optional[str] = None) -> MetricsHTTPServer:
@@ -296,7 +339,7 @@ def maybe_start_http() -> Optional[MetricsHTTPServer]:
 # -- test hygiene -----------------------------------------------------------
 def reset() -> None:
     """Tear down the global state (tests): registry, watchdog, sink,
-    HTTP server."""
+    HTTP server, health providers, trace rings."""
     global _watchdog, _jsonl, _jsonl_cfg, _jsonl_pinned, _http, \
         _http_failed_port
     with _lock:
@@ -313,3 +356,5 @@ def reset() -> None:
             _http.stop()
         _http = None
         _http_failed_port = None
+        _health.clear()
+    trace.reset()

@@ -95,14 +95,31 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     registry: Optional[MetricsRegistry] = None   # set per server subclass
 
     def do_GET(self):                            # noqa: N802 (stdlib API)
-        if self.path.split("?")[0] not in ("/", "/metrics"):
-            self.send_response(404)
-            self.end_headers()
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            # the fleet probe endpoint: aggregate every registered
+            # health provider (live ModelServers, the registry) —
+            # 200 when all report ready, 503 otherwise, JSON either way
+            from . import healthz_status
+
+            ready, payload = healthz_status()
+            body = json.dumps(payload).encode()
+            self._respond(200 if ready else 503, body,
+                          "application/json; charset=utf-8")
+            return
+        if path not in ("/", "/metrics"):
+            # explicit body + Content-Length: the client gets a framed
+            # 404 immediately instead of waiting on the socket
+            self._respond(404, b"not found\n",
+                          "text/plain; charset=utf-8")
             return
         body = prometheus_text(self.registry).encode()
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+        self._respond(200, body,
+                      "text/plain; version=0.0.4; charset=utf-8")
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

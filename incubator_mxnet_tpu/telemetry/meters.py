@@ -133,6 +133,17 @@ _NULL_CTX = _NullCtx()
 _EMA_ALPHA = 0.3
 
 
+def _meter_id_counter():
+    import itertools
+
+    return itertools.count(1)
+
+
+#: process-wide meter numbering: the ``meter`` gauge label that keeps
+#: two meters on one site from overwriting each other's gauges
+_meter_ids = _meter_id_counter()
+
+
 class _StepScope:
     """The live per-step context: measures wall time, attributes
     compiles, commits instruments on exit."""
@@ -187,15 +198,16 @@ class StepMeter:
     shared null context when telemetry is disabled.
 
     Two live meters sharing one site name (two Trainers stepping
-    concurrently — a GAN's generator and discriminator) interleave
-    their writes to the site-labelled EMA/MFU gauges and mix their
-    step-time histograms; the JSONL stream stays separable (each meter
-    emits its own records) but the exported gauges flip between the
-    two. Alternate distinct workloads through differently-named sites
-    if their gauges must be read independently."""
+    concurrently — a GAN's generator and discriminator) keep their
+    *gauges* apart: the per-step gauges (EMA, MFU, FLOPs) carry a
+    ``meter`` label next to ``site``, so concurrent meters stop
+    overwriting each other's values. Counters and histograms stay
+    site-keyed — aggregating steps/seconds across the meters of one
+    site is the useful reading there."""
 
     def __init__(self, site: str):
         self.site = site
+        self.meter_id = f"m{next(_meter_ids)}"
         self._last_step = 0
         self._ema_s: Optional[float] = None
         self._insts = None
@@ -211,13 +223,19 @@ class StepMeter:
             from . import counter, gauge, histogram
 
             s = {"site": self.site}
+            # gauges are keyed by (site, meter): a gauge holds "the
+            # latest value", and two meters on one site would otherwise
+            # overwrite each other's EMA/MFU (the old documented
+            # cross-talk caveat). Counters/histograms aggregate, so
+            # they stay site-keyed.
+            g = {"site": self.site, "meter": self.meter_id}
             self._insts = {
                 "steps": counter("mxtpu_step_total",
                                  "steps executed", **s),
                 "seconds": histogram("mxtpu_step_seconds",
                                      "step wall time", **s),
                 "ema": gauge("mxtpu_step_time_ema_seconds",
-                             "EMA of step wall time", **s),
+                             "EMA of step wall time", **g),
                 "dispatches": counter("mxtpu_step_dispatches_total",
                                       "executable dispatches", **s),
                 "h2d": counter("mxtpu_h2d_bytes_total",
@@ -226,9 +244,9 @@ class StepMeter:
                 "mfu": gauge("mxtpu_mfu_percent",
                              "online MFU: cost-analysis FLOPs over the "
                              "step-time EMA vs the measured ceiling",
-                             **s),
+                             **g),
                 "flops": gauge("mxtpu_step_flops",
-                               "XLA cost-analysis FLOPs per step", **s),
+                               "XLA cost-analysis FLOPs per step", **g),
                 # unlabelled process-wide gauges, cached here so the hot
                 # path never re-resolves them through the registry lock
                 "mem": gauge("mxtpu_device_bytes_in_use",
@@ -321,6 +339,12 @@ class StepMeter:
             rec["detail"] = scope.detail
         scope.record = rec
         jsonl_emit(rec)
+        # flight recorder: every step commit lands in the always-on
+        # ring (one deque append), so an incident dump carries the
+        # recent step ledger even with span sampling off
+        from .trace import flight_step
+
+        flight_step(rec)
         self._correlate(scope, dt, rec)
 
     def _correlate(self, scope: _StepScope, dt: float, rec: Dict) -> None:

@@ -283,6 +283,12 @@ class RecompileWatchdog:
                     "step": step, "event": event,
                     "duration_ms": round(duration_s * 1e3, 3),
                     "ts": ev.ts})
+        # a flagged post-warmup recompile is a trigger-engine event:
+        # capture one bounded profiler trace of the drift (debounced,
+        # no-op unless MXTPU_TRACE_TRIGGER is on)
+        from .trace import trigger    # lazy: avoid cycle
+
+        trigger("recompile", site=site, detail=detail)
 
     # -- reads --------------------------------------------------------------
     def flagged(self, site: Optional[str] = None) -> List[RecompileEvent]:

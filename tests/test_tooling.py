@@ -525,3 +525,41 @@ def test_telemetry_report_shows_decision_record(tmp_path):
     assert "0.040" in out  # bench disp/step column
     metrics = rep._comparable_metrics(rep._read(str(sink)))
     assert metrics["decision/resnet_decision_part_d/ratio"] == 0.97
+
+
+def test_observability_doc_catalogs_every_metric_family():
+    """Doc-sync for docs/OBSERVABILITY.md (the ENV_VARS.md discipline
+    applied to metrics): every ``mxtpu_*`` metric family instantiated
+    in the runtime — a ``counter(``/``gauge(``/``histogram(`` call with
+    a literal name — must have a row in the catalog. A new instrument
+    without documentation fails CI here."""
+    import re
+
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+        doc = f.read()
+    # the catalog compresses sibling families with one-level brace
+    # expansion (`mxtpu_serving_cache_{hits,misses}_total`) — expand it
+    documented = set()
+    for tok in re.findall(r"mxtpu_[a-z0-9_]*(?:\{[a-z0-9_,]+\})?"
+                          r"[a-z0-9_]*", doc):
+        m = re.match(r"(.*)\{([^}]+)\}(.*)", tok)
+        if m:
+            documented.update(m.group(1) + alt + m.group(3)
+                              for alt in m.group(2).split(","))
+        else:
+            documented.add(tok)
+    pat = re.compile(
+        r"""(?:counter|gauge|histogram)\(\s*["'](mxtpu_[a-z0-9_]+)["']""")
+    families = set()
+    pkg = os.path.join(REPO, "incubator_mxnet_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                families.update(pat.findall(f.read()))
+    assert families, "metric-family scan found nothing — pattern broken?"
+    missing = sorted(families - documented)
+    assert not missing, (
+        f"metric families missing from docs/OBSERVABILITY.md: {missing} "
+        "— add catalog rows for them")
